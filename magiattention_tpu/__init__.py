@@ -1,0 +1,46 @@
+"""magiattention_tpu — a TPU-native distributed-attention framework.
+
+A from-scratch JAX / XLA / Pallas implementation of the capabilities of
+MagiAttention (context-parallel attention for ultra-long-context,
+heterogeneous-mask training): flex-flash-attention over ``AttnSlice``
+metadata, load-balanced sequence dispatch, GroupCast/GroupReduce collectives
+over ICI, and a multi-stage compute/comm-overlap CP runtime.
+"""
+
+import logging as _logging
+import os as _os
+
+__version__ = "0.1.0"
+
+_logger = _logging.getLogger("magiattention_tpu")
+if not _logger.handlers:
+    _handler = _logging.StreamHandler()
+    _handler.setFormatter(
+        _logging.Formatter("[%(asctime)s][%(name)s][%(levelname)s] %(message)s")
+    )
+    _logger.addHandler(_handler)
+_logger.setLevel(_os.environ.get("MAGI_ATTENTION_LOG_LEVEL", "WARNING").upper())
+
+from . import common, config, env  # noqa: F401, E402
+from .config import (  # noqa: F401, E402
+    DispatchConfig,
+    DistAttnConfig,
+    GrpCollConfig,
+    OverlapConfig,
+)
+
+
+def __getattr__(name):
+    # lazy: the api module pulls in jax; keep `import magiattention_tpu` light
+    if name in (
+        "magi_attn_flex_key",
+        "magi_attn_varlen_key",
+        "dispatch",
+        "undispatch",
+        "calc_attn",
+        "get_position_ids",
+    ):
+        from . import api
+
+        return getattr(api, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,38 @@
+"""Common host-side data structures.
+
+When the C++ host backend is built and ``MAGI_ATTENTION_CPP_BACKEND=1``
+(default), ``AttnRange``/``AttnRanges`` resolve to the native implementations
+(ref: magi_attention/common/__init__.py:17-34); otherwise the pure-Python
+implementations are used. Both conform to ``common.protocols``.
+"""
+
+from .enum import AttnMaskType, AttnRole, AttnType  # noqa: F401
+from .forward_meta import AttnForwardMeta  # noqa: F401
+from .mask import AttnMask  # noqa: F401
+
+from .range import AttnRange as _PyAttnRange
+from .ranges import AttnRanges as _PyAttnRanges
+
+AttnRange = _PyAttnRange
+AttnRanges = _PyAttnRanges
+
+from .. import env as _env  # noqa: E402
+
+if _env.general.is_cpp_backend_enable():  # pragma: no branch
+    try:
+        from ..csrc_backend import CppAttnRange, CppAttnRanges  # noqa: F401
+
+        AttnRange = CppAttnRange  # type: ignore[misc]
+        AttnRanges = CppAttnRanges  # type: ignore[misc]
+    except ImportError:
+        pass
+
+__all__ = [
+    "AttnForwardMeta",
+    "AttnMask",
+    "AttnMaskType",
+    "AttnRange",
+    "AttnRanges",
+    "AttnRole",
+    "AttnType",
+]

@@ -1,0 +1,19 @@
+"""Forward-pass side outputs (ref: magi_attention/common/forward_meta.py:21)."""
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class AttnForwardMeta:
+    """Side outputs returned by every attention call.
+
+    Attributes:
+        lse: log-sum-exp of attention logits, shape ``[seqlen_q, num_heads]``
+            (fp32), or None when not requested.
+        max_logits: per-head max attention logit (fp32), or None when not
+            requested.
+    """
+
+    lse: Any = None
+    max_logits: Any = None

@@ -1,0 +1,61 @@
+"""Structured per-call configuration (ref: magi_attention/config.py:54-71)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .common.enum import AttnOverlapMode, DispatchAlgType, OverlapAlgType
+
+
+@dataclass(frozen=True)
+class DispatchConfig:
+    """Config for the load-balance dispatch solver.
+
+    Attributes:
+        alg: chunk->rank assignment algorithm.
+        chunk_size: sequence chunk granularity; None = auto-derive.
+    """
+
+    alg: DispatchAlgType = DispatchAlgType.MIN_HEAP
+    chunk_size: int | None = None
+
+
+@dataclass(frozen=True)
+class OverlapConfig:
+    """Config for multi-stage compute/comm overlap.
+
+    Attributes:
+        enable: False collapses to the single-stage (no-overlap) path.
+        mode: static (fixed degree) or dynamic (solver-chosen).
+        degree: number of remote stages when static; None = solver decides.
+        min_chunk_size / max_num_chunks: remote-workload chunking bounds.
+        alg: stage-grouping algorithm.
+    """
+
+    enable: bool = True
+    mode: AttnOverlapMode = AttnOverlapMode.STATIC
+    degree: int | None = 1
+    min_chunk_size: int = 512
+    max_num_chunks: int = 64
+    alg: OverlapAlgType = OverlapAlgType.UNIFORM
+
+
+@dataclass(frozen=True)
+class GrpCollConfig:
+    """Config for the group-collective lowering.
+
+    Attributes:
+        split_alignment: pad per-destination split sizes to this multiple so
+            `jax.lax.all_to_all` sees equal static splits (TPU lane = 128).
+    """
+
+    split_alignment: int = 128
+
+
+@dataclass(frozen=True)
+class DistAttnConfig:
+    """Top-level distributed-attention config (passed per key-init)."""
+
+    dispatch_config: DispatchConfig = field(default_factory=DispatchConfig)
+    overlap_config: OverlapConfig = field(default_factory=OverlapConfig)
+    grpcoll_config: GrpCollConfig = field(default_factory=GrpCollConfig)

@@ -1,0 +1,9 @@
+"""Typed env-flag system (ref: magi_attention/env/ — §2.1 of SURVEY).
+
+All runtime toggles are read through typed getter functions (never raw
+``os.environ`` at call sites). Behavior-affecting flags are snapshotted into
+the runtime cache key via :func:`snapshot_env`.
+"""
+
+from . import comm, general, kernel  # noqa: F401
+from .general import snapshot_env  # noqa: F401

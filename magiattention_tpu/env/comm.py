@@ -1,0 +1,30 @@
+"""Communication toggles (ref: magi_attention/env/comm.py:33-172)."""
+
+from __future__ import annotations
+
+from .general import _get_bool, _get_int
+
+
+def is_hierarchical_comm_enable() -> bool:
+    """2-level (DCN x ICI) group-collective planning."""
+    return _get_bool("MAGI_ATTENTION_HIERARCHICAL_COMM")
+
+
+def is_qo_comm_enable() -> bool:
+    """Move q/o/do instead of (only) kv — enables the dynamic solver."""
+    return _get_bool("MAGI_ATTENTION_QO_COMM")
+
+
+def is_fwd_high_precision_reduce_enable() -> bool:
+    """Reduce partial out in fp32 instead of the compute dtype."""
+    return _get_bool("MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE", default=True)
+
+
+def is_bwd_high_precision_reduce_enable() -> bool:
+    """Reduce partial dkv in fp32 instead of the compute dtype."""
+    return _get_bool("MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE", default=True)
+
+
+def split_alignment() -> int:
+    """Pad collective split sizes to a multiple of this (TPU lane alignment)."""
+    return _get_int("MAGI_ATTENTION_SPLIT_ALIGNMENT", 128)

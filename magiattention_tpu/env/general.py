@@ -1,0 +1,96 @@
+"""General runtime toggles (ref: magi_attention/env/general.py:56-287).
+
+Flag names keep the ``MAGI_ATTENTION_`` prefix for drop-in familiarity with the
+reference; values are read lazily on each call so tests can monkeypatch
+``os.environ``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _get_bool(name: str, default: bool = False) -> bool:
+    return os.environ.get(name, "1" if default else "0") == "1"
+
+
+def _get_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def _get_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def log_level() -> str:
+    return _get_str("MAGI_ATTENTION_LOG_LEVEL", "WARNING").upper()
+
+
+def is_sanity_check_enable() -> bool:
+    """Expensive invariant checks throughout solver/comm planning."""
+    return _get_bool("MAGI_ATTENTION_SANITY_CHECK")
+
+
+def kernel_backend() -> str:
+    """Attention kernel backend: ffa | sdpa | sdpa_online."""
+    return _get_str("MAGI_ATTENTION_KERNEL_BACKEND", "ffa").lower()
+
+
+def precision() -> str:
+    """Precision override for attention compute: default | fp32 | bf16."""
+    return _get_str("MAGI_ATTENTION_PRECISION", "default").lower()
+
+
+def is_deterministic_mode_enable() -> bool:
+    """Deterministic reduction ordering for partial-result merging."""
+    return _get_bool("MAGI_ATTENTION_DETERMINISTIC_MODE")
+
+
+def is_profile_mode_enable() -> bool:
+    return _get_bool("MAGI_ATTENTION_PROFILE_MODE")
+
+
+def is_range_merge_enable() -> bool:
+    """Merge adjacent compatible slices before kernel launch."""
+    return _get_bool("MAGI_ATTENTION_RANGE_MERGE", default=True)
+
+
+def runtime_dict_size() -> int:
+    """LRU capacity of the per-mesh runtime cache."""
+    return _get_int("MAGI_ATTENTION_RUNTIME_DICT_SIZE", 100)
+
+
+def min_chunks_per_rank() -> int:
+    return _get_int("MAGI_ATTENTION_MIN_CHUNKS_PER_RANK", 1)
+
+
+def is_cpp_backend_enable() -> bool:
+    """Use the C++ host backend for ranges / solver hot loops when built."""
+    return _get_bool("MAGI_ATTENTION_CPP_BACKEND", default=True)
+
+
+def is_interpret_mode_enable() -> bool:
+    """Force Pallas kernels into interpreter mode (CPU testing)."""
+    return _get_bool("MAGI_ATTENTION_PALLAS_INTERPRET")
+
+
+# flags that change numerics / planning output and therefore must be part of
+# every runtime cache key (ref: env/ffa.py:125 ENV_KEYS_AFFECTING_COMPILATION)
+ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
+    "MAGI_ATTENTION_KERNEL_BACKEND",
+    "MAGI_ATTENTION_PRECISION",
+    "MAGI_ATTENTION_DETERMINISTIC_MODE",
+    "MAGI_ATTENTION_RANGE_MERGE",
+    "MAGI_ATTENTION_MIN_CHUNKS_PER_RANK",
+    "MAGI_ATTENTION_CPP_BACKEND",
+    "MAGI_ATTENTION_PALLAS_INTERPRET",
+    "MAGI_ATTENTION_HIGH_PRECISION_REDUCE",
+    "MAGI_ATTENTION_QO_COMM",
+    "MAGI_ATTENTION_FFA_BLOCK_Q",
+    "MAGI_ATTENTION_FFA_BLOCK_K",
+)
+
+
+def snapshot_env() -> tuple[tuple[str, str | None], ...]:
+    """Hashable snapshot of every behavior-affecting flag."""
+    return tuple((k, os.environ.get(k)) for k in ENV_KEYS_AFFECTING_RUNTIME)

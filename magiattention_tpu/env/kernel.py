@@ -1,0 +1,20 @@
+"""Pallas FFA kernel tuning flags (ref: magi_attention/env/ffa.py)."""
+
+from __future__ import annotations
+
+from .general import _get_int
+
+
+def ffa_block_q() -> int:
+    """Q tile rows per grid step (multiple of 8 for fp32 / 16 for bf16)."""
+    return _get_int("MAGI_ATTENTION_FFA_BLOCK_Q", 256)
+
+
+def ffa_block_k() -> int:
+    """K tile rows per grid step (multiple of 128)."""
+    return _get_int("MAGI_ATTENTION_FFA_BLOCK_K", 512)
+
+
+def ffa_max_slices() -> int:
+    """Static upper bound on slice count per AttnArg (padding bucket)."""
+    return _get_int("MAGI_ATTENTION_FFA_MAX_SLICES", 64)

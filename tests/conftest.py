@@ -1,0 +1,17 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's no-cluster strategy (testing/dist_common.py spawns N
+local processes); on TPU/JAX the idiomatic substitute is
+``xla_force_host_platform_device_count`` + ``shard_map`` in a single process.
+Pallas kernels run in interpreter mode on CPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("MAGI_ATTENTION_PALLAS_INTERPRET", "1")

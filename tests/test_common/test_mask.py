@@ -1,0 +1,69 @@
+"""Unit tests for AttnMask materialization + slice geometry."""
+
+import numpy as np
+
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask, slice_area, slice_mask_block
+from magiattention_tpu.common.range import AttnRange
+from magiattention_tpu.common.ranges import AttnRanges
+
+
+def brute_mask(qr, kr, mt):
+    out = np.zeros((qr.seqlen, kr.seqlen), dtype=bool)
+    for qi, i in enumerate(range(qr.start, qr.end)):
+        for kj, j in enumerate(range(kr.start, kr.end)):
+            d = j - i
+            if mt == AttnMaskType.FULL:
+                ok = True
+            elif mt == AttnMaskType.CAUSAL:
+                ok = d <= kr.end - qr.end
+            elif mt == AttnMaskType.INVCAUSAL:
+                ok = d >= kr.start - qr.start
+            else:
+                ok = (d <= kr.end - qr.end) and (d >= kr.start - qr.start)
+            out[qi, kj] = ok
+    return out
+
+
+def test_slice_mask_block_all_types():
+    cases = [
+        (AttnRange(0, 8), AttnRange(0, 8)),
+        (AttnRange(0, 4), AttnRange(0, 12)),  # sk > sq
+        (AttnRange(0, 12), AttnRange(4, 8)),  # sq > sk
+        (AttnRange(3, 9), AttnRange(1, 11)),  # offset
+    ]
+    for qr, kr in cases:
+        for mt in AttnMaskType:
+            got = slice_mask_block(qr, kr, mt)
+            want = brute_mask(qr, kr, mt)
+            assert (got == want).all(), (qr, kr, mt)
+            assert slice_area(qr, kr, mt) == int(want.sum()), (qr, kr, mt)
+
+
+def test_causal_alignment_bottom_right():
+    # causal over a wide box: last q row sees all keys
+    m = slice_mask_block(AttnRange(0, 4), AttnRange(0, 8), AttnMaskType.CAUSAL)
+    assert m[-1].all()
+    assert m[0].sum() == 5  # 8 - 4 + 1
+
+
+def test_attn_mask_from_ranges():
+    q_ranges = AttnRanges.from_ranges([(0, 4), (4, 8)])
+    k_ranges = AttnRanges.from_ranges([(0, 4), (0, 8)])
+    mask = AttnMask.from_ranges(
+        q_ranges, k_ranges, [AttnMaskType.CAUSAL, AttnMaskType.CAUSAL]
+    )
+    # this is exactly a full causal mask over seqlen 8
+    assert mask.is_pure_causal()
+    assert mask.area == 8 * 9 // 2
+
+
+def test_attn_mask_area_matches_slices():
+    q_ranges = AttnRanges.from_ranges([(0, 6), (6, 16)])
+    k_ranges = AttnRanges.from_ranges([(0, 16), (2, 10)])
+    types = [AttnMaskType.FULL, AttnMaskType.BICAUSAL]
+    mask = AttnMask.from_ranges(q_ranges, k_ranges, types)
+    manual = sum(
+        slice_area(qr, kr, mt) for qr, kr, mt in zip(q_ranges, k_ranges, types)
+    )
+    assert mask.area == manual  # slices are disjoint here

@@ -1,0 +1,126 @@
+"""Unit tests for AttnRange / AttnRanges algebra (pure CPU).
+
+Modeled on the reference's tests/test_common/test_attn_ranges.py coverage.
+"""
+
+import pytest
+
+from magiattention_tpu.common.range import AttnRange, RangeError
+from magiattention_tpu.common.ranges import AttnRanges
+
+
+class TestAttnRange:
+    def test_basic(self):
+        r = AttnRange(2, 10)
+        assert r.start == 2 and r.end == 10 and r.seqlen == 8
+        assert not r.is_empty()
+        assert AttnRange(3, 3).is_empty()
+
+    def test_invalid(self):
+        with pytest.raises(RangeError):
+            AttnRange(5, 3)
+        with pytest.raises(RangeError):
+            AttnRange(-1, 3)
+
+    def test_intersect(self):
+        a, b = AttnRange(0, 10), AttnRange(5, 15)
+        assert a.intersect(b) == AttnRange(5, 10)
+        assert a.intersect(AttnRange(20, 30)).is_empty()
+        assert a.intersect_size(b) == 5
+        assert a.intersect_size(AttnRange(12, 15)) == 0
+
+    def test_subrange_overlap(self):
+        a = AttnRange(0, 10)
+        assert AttnRange(2, 5).is_subrange_of(a)
+        assert not AttnRange(5, 12).is_subrange_of(a)
+        assert AttnRange(5, 12).is_overlap_with(a)
+        assert not AttnRange(10, 12).is_overlap_with(a)  # adjacent, not overlap
+        assert AttnRange(10, 12).is_adjacent_to(a)
+
+    def test_diff(self):
+        a = AttnRange(0, 10)
+        assert a.diff_by(AttnRange(3, 6)) == [AttnRange(0, 3), AttnRange(6, 10)]
+        assert a.diff_by(AttnRange(0, 6)) == [AttnRange(6, 10)]
+        assert a.diff_by(AttnRange(0, 10)) == []
+        assert a.diff_by(AttnRange(20, 30)) == [a]
+
+    def test_union(self):
+        assert AttnRange(0, 5).union(AttnRange(5, 8)) == AttnRange(0, 8)
+        assert AttnRange(0, 6).union(AttnRange(4, 8)) == AttnRange(0, 8)
+        with pytest.raises(RangeError):
+            AttnRange(0, 3).union(AttnRange(5, 8))
+
+    def test_truncate_offset(self):
+        assert AttnRange(2, 10).truncate(4, 8) == AttnRange(4, 8)
+        assert AttnRange(2, 10).truncate(end=5) == AttnRange(2, 5)
+        assert AttnRange(2, 10).offset(100) == AttnRange(102, 110)
+
+
+class TestAttnRanges:
+    def test_construct(self):
+        rs = AttnRanges.from_ranges([(0, 4), (8, 12)])
+        assert len(rs) == 2
+        assert rs.total_seqlen == 8
+        assert rs.start == 0 and rs.end == 12
+        assert rs.max_seqlen == 4
+
+    def test_cu_seqlens_roundtrip(self):
+        rs = AttnRanges.from_cu_seqlens([0, 4, 4, 10], seq_len=10)
+        assert rs.is_cu_seqlens(10)
+        assert rs.to_cu_seqlens(10) == [0, 4, 4, 10]
+        with pytest.raises(RangeError):
+            AttnRanges.from_cu_seqlens([1, 4])
+
+    def test_sort_merge(self):
+        rs = AttnRanges.from_ranges([(8, 12), (0, 4), (3, 6), (12, 14)])
+        assert not rs.is_sorted()
+        assert rs.sort().is_sorted()
+        merged = rs.merge()
+        assert merged == AttnRanges.from_ranges([(0, 6), (8, 14)])
+        assert merged.is_merged()
+        assert rs.intersect_size() == 12
+
+    def test_holes_and_overlaps(self):
+        a = AttnRanges.from_ranges([(0, 10), (20, 30)])
+        b = AttnRanges.from_ranges([(4, 6), (8, 25)])
+        holes = a.find_hole_ranges(b)
+        assert holes == AttnRanges.from_ranges([(0, 4), (6, 8), (25, 30)])
+        overlaps = a.find_overlap_ranges(b)
+        assert overlaps == AttnRanges.from_ranges([(4, 6), (8, 10), (20, 25)])
+        assert a.intersect_size_with(b) == 2 + 2 + 5
+        assert a.union_size_with(b) == 30  # [0,10)+[4,6)+[8,25)+[20,30) = [0,30)
+
+    def test_self_overlap(self):
+        rs = AttnRanges.from_ranges([(0, 10), (5, 15), (20, 25)])
+        assert rs.find_overlap_ranges_with_self() == AttnRanges.from_ranges([(5, 10)])
+        assert not rs.is_non_overlap()
+        assert AttnRanges.from_ranges([(0, 5), (5, 8)]).is_non_overlap()
+
+    def test_chunk(self):
+        rs = AttnRanges.from_ranges([(0, 6), (10, 16)])
+        chunks = rs.chunk(4)
+        assert len(chunks) == 3
+        assert chunks[0] == AttnRanges.from_ranges([(0, 4)])
+        assert chunks[1] == AttnRanges.from_ranges([(4, 6), (10, 12)])
+        assert chunks[2] == AttnRanges.from_ranges([(12, 16)])
+        with pytest.raises(RangeError):
+            rs.chunk(5, check=True)
+
+    def test_make_local(self):
+        host = AttnRanges.from_ranges([(4, 8), (12, 20)])
+        assert host.make_range_local(AttnRange(5, 7)) == AttnRange(1, 3)
+        assert host.make_range_local(AttnRange(12, 16)) == AttnRange(4, 8)
+        local = host.make_ranges_local(AttnRanges.from_ranges([(6, 8), (12, 14)]))
+        assert local == AttnRanges.from_ranges([(2, 4), (4, 6)])
+        # a range spanning a hole gets split
+        spanning = host.make_ranges_local(AttnRanges.from_ranges([(6, 14)]))
+        assert spanning == AttnRanges.from_ranges([(2, 4), (4, 6)])
+        with pytest.raises(RangeError):
+            host.make_range_local(AttnRange(0, 2))
+
+    def test_to_array(self):
+        rs = AttnRanges.from_ranges([(0, 4), (8, 12)])
+        arr = rs.to_array()
+        assert arr.shape == (2, 2)
+        assert arr.dtype.name == "int32"
+        assert arr.tolist() == [[0, 4], [8, 12]]
