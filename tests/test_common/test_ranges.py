@@ -112,9 +112,9 @@ class TestAttnRanges:
         assert host.make_range_local(AttnRange(12, 16)) == AttnRange(4, 8)
         local = host.make_ranges_local(AttnRanges.from_ranges([(6, 8), (12, 14)]))
         assert local == AttnRanges.from_ranges([(2, 4), (4, 6)])
-        # a range spanning a hole gets split
-        spanning = host.make_ranges_local(AttnRanges.from_ranges([(6, 14)]))
-        assert spanning == AttnRanges.from_ranges([(2, 4), (4, 6)])
+        # a range spanning the hole [8,12) is not covered -> error
+        with pytest.raises(RangeError):
+            host.make_ranges_local(AttnRanges.from_ranges([(6, 14)]))
         with pytest.raises(RangeError):
             host.make_range_local(AttnRange(0, 2))
 
