@@ -1,0 +1,1 @@
+"""Functional runtime: kernel entry points, dispatch ops, CP engine."""

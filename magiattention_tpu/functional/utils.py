@@ -1,0 +1,78 @@
+"""Partial-attention merge math (ref: magi_attention/functional/utils.py).
+
+The log-sum-exp merge identities used everywhere partial attention results are
+combined: between online-softmax blocks inside the kernels, and between
+host/remote partial results in the CP runtime (GroupReduce with op="lse").
+All functions are -inf safe: a fully-masked partial (lse=-inf, out=0)
+contributes nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def safe_logaddexp(a: jax.Array, b: jax.Array) -> jax.Array:
+    """logaddexp that returns -inf (not nan) when both inputs are -inf."""
+    both_inf = jnp.isneginf(a) & jnp.isneginf(b)
+    m = jnp.maximum(a, b)
+    m_safe = jnp.where(both_inf, 0.0, m)
+    out = m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe))
+    return jnp.where(both_inf, -jnp.inf, out)
+
+
+def correct_attn_lse(lse1: jax.Array, lse2: jax.Array) -> jax.Array:
+    """Merged lse of two partial attentions over disjoint key sets."""
+    return safe_logaddexp(lse1, lse2)
+
+
+def correct_attn_out_lse(
+    out1: jax.Array,
+    lse1: jax.Array,
+    out2: jax.Array,
+    lse2: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Merge two partial attention results over disjoint key sets.
+
+    Args:
+        out1/out2: ``[s, h, dv]`` partial outputs.
+        lse1/lse2: ``[s, h]`` partial lse (fp32, -inf where empty).
+
+    Returns:
+        (out, lse) of the union, in the dtypes of the inputs.
+    """
+    lse = correct_attn_lse(lse1, lse2)
+    w1 = jnp.exp(jnp.where(jnp.isneginf(lse1), -jnp.inf, lse1 - jnp.where(jnp.isneginf(lse), 0.0, lse)))
+    w2 = jnp.exp(jnp.where(jnp.isneginf(lse2), -jnp.inf, lse2 - jnp.where(jnp.isneginf(lse), 0.0, lse)))
+    out_dtype = out1.dtype
+    out = (
+        out1.astype(jnp.float32) * w1[..., None]
+        + out2.astype(jnp.float32) * w2[..., None]
+    )
+    return out.astype(out_dtype), lse
+
+
+def lse_weighted_reduce(
+    outs: jax.Array,
+    lses: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Merge P stacked partials in one shot.
+
+    Args:
+        outs: ``[P, s, h, dv]`` partial outputs.
+        lses: ``[P, s, h]`` partial lses (fp32, -inf where empty).
+
+    Returns:
+        (out ``[s, h, dv]``, lse ``[s, h]``).
+    """
+    m = jnp.max(lses, axis=0)  # [s, h]
+    all_inf = jnp.isneginf(m)
+    m_safe = jnp.where(all_inf, 0.0, m)
+    w = jnp.exp(lses - m_safe[None])  # [P, s, h]; exp(-inf - c) = 0
+    denom = jnp.sum(w, axis=0)
+    lse = jnp.where(all_inf, -jnp.inf, m_safe + jnp.log(denom))
+    out = jnp.einsum(
+        "pshd,psh->shd", outs.astype(jnp.float32), w
+    ) / jnp.where(all_inf, 1.0, denom)[..., None]
+    return out.astype(outs.dtype), lse

@@ -1,0 +1,1 @@
+"""Device compute kernels: Pallas FFA + jnp reference backends."""

@@ -1,0 +1,595 @@
+"""Flex-flash-attention Pallas TPU kernels (fwd + bwd).
+
+TPU-native counterpart of the reference FFA CUDA kernel
+(magi_attention/csrc/flexible_flash_attention/ — fwd/bwd mainloops, tile
+schedulers, mask.h). Design differences, deliberate and TPU-first:
+
+- The device-side persistent tile scheduler is replaced by a host-side plan
+  (:mod:`ffa_plan`) + ``PrefetchScalarGridSpec``: the grid is exactly the list
+  of non-empty (q_tile, k_tile, slice) work items, so fully-masked tiles cost
+  nothing and no dynamic control flow reaches the MXU.
+- The atomic-reduce epilogues (epilogue_fwd.hpp / epilogue_bwd.hpp) are
+  replaced by run-ordering: all work items of one output tile are consecutive
+  grid steps accumulating into VMEM scratch; the tile is written once at the
+  end of its run. dq uses the q-major plan, dk/dv the k-major plan — no
+  atomics exist on TPU and none are needed.
+- Online-softmax merge math matches functional/utils.py (lse in natural log,
+  -inf on fully-masked rows).
+
+Layouts inside the kernels are head-major ``[h, s, d]`` so each block is a
+contiguous ``(s_tile, d)`` matrix on the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..env import general as env_general
+from ..env import kernel as env_kernel
+from .ffa_plan import IS_FIRST, IS_LAST, KE, KS, QE, QS, TYPE, FFAPlan, get_ffa_plan
+
+NEG_INF = float("-inf")
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@dataclass(frozen=True, eq=False)
+class FFAParams:
+    """Static kernel parameters (hashable by identity for custom_vjp)."""
+
+    plan: FFAPlan
+    softmax_scale: float
+    softcap: float
+    group: int  # hq // hk
+    interpret: bool
+
+
+def _item_mask(
+    meta_ref, w, q_base, k_base, bq: int, bk: int, transposed: bool = False
+):
+    """Boolean mask of work item w on the tile at (q_base, k_base).
+
+    Shape (bq, bk) with q rows, or (bk, bq) when ``transposed`` (k rows) —
+    built directly with swapped iota since Mosaic cannot transpose i1 vectors.
+    """
+    qs, qe = meta_ref[w, QS], meta_ref[w, QE]
+    ks, ke = meta_ref[w, KS], meta_ref[w, KE]
+    t = meta_ref[w, TYPE]
+    if transposed:
+        rows = q_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+        cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+    else:
+        rows = q_base + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    in_rect = (rows >= qs) & (rows < qe) & (cols >= ks) & (cols < ke)
+    d = cols - rows
+    causal_ok = d <= (ke - qe)
+    inv_ok = d >= (ks - qs)
+    # scalar type flags combined via boolean algebra (Mosaic cannot select on
+    # i1 vectors): CAUSAL/BICAUSAL impose causal_ok, INVCAUSAL/BICAUSAL inv_ok
+    is_causal = (t == 1) | (t == 3)
+    is_inv = (t == 2) | (t == 3)
+    ok = (jnp.logical_not(is_causal) | causal_ok) & (
+        jnp.logical_not(is_inv) | inv_ok
+    )
+    return in_rect & ok
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    work_qt_ref,
+    work_kt_ref,
+    meta_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    out_ref,
+    lse_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    softcap: float,
+    bq: int,
+    bk: int,
+):
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    q_base = work_qt_ref[w] * bq
+    k_base = work_kt_ref[w] * bk
+
+    @pl.when(is_first == 1)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if softcap > 0.0:
+        s = softcap * jnp.tanh(s / softcap)
+    mask = _item_mask(meta_ref, w, q_base, k_base, bq, bk)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]  # (bq, 1)
+    m_blk = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev = -inf, m_safe finite
+    alpha = jnp.where(jnp.isneginf(m_prev) & jnp.isneginf(m_new), 0.0, alpha)
+
+    l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype),
+        v_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[:] = acc_scr[:] * alpha + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(is_last == 1)
+    def _():
+        l = l_scr[:, :1]
+        empty = l == 0.0
+        l_safe = jnp.where(empty, 1.0, l)
+        out_ref[0] = (acc_scr[:] / l_safe).astype(out_ref.dtype)
+        lse = jnp.where(
+            empty[:, 0], NEG_INF, m_scr[:, 0] + jnp.log(l_safe[:, 0])
+        )
+        lse_ref[...] = lse.astype(jnp.float32)[:, None]
+
+
+def _ffa_fwd_pallas(params: FFAParams, q_t, k_t, v_t):
+    """q_t/k_t/v_t are head-major padded: [hq,sqp,d], [hk,skp,d], [hk,skp,dv]."""
+    plan = params.plan
+    bq, bk = plan.block_q, plan.block_k
+    hq, sqp, d = q_t.shape
+    hk, skp, dv = v_t.shape
+    g = params.group
+    W = plan.num_work
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(hq, W),
+        in_specs=[
+            pl.BlockSpec(
+                (1, bq, d), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, w, qt, kt, mt: (h // g, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, dv), lambda h, w, qt, kt, mt: (h // g, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, bq, dv), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, dv), jnp.float32),
+        ],
+    )
+
+    kernel = partial(
+        _fwd_kernel,
+        scale=params.softmax_scale,
+        softcap=params.softcap,
+        bq=bq,
+        bk=bk,
+    )
+    out_t, lse_t = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hq, sqp, dv), q_t.dtype),
+            jax.ShapeDtypeStruct((hq, sqp, 1), jnp.float32),
+        ],
+        interpret=params.interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * W * bq * bk * d * hq,
+            bytes_accessed=(q_t.size + k_t.size + v_t.size) * q_t.dtype.itemsize,
+            transcendentals=W * bq * bk * hq,
+        ),
+    )(
+        jnp.asarray(plan.work_qt),
+        jnp.asarray(plan.work_kt),
+        jnp.asarray(plan.meta),
+        q_t,
+        k_t,
+        v_t,
+    )
+    return out_t, lse_t[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# backward: dq (q-major plan)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    work_qt_ref,
+    work_kt_ref,
+    meta_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    scale: float,
+    softcap: float,
+    bq: int,
+    bk: int,
+):
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    q_base = work_qt_ref[w] * bq
+    k_base = work_kt_ref[w] * bk
+
+    @pl.when(is_first == 1)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if softcap > 0.0:
+        sc = softcap * jnp.tanh(s / softcap)
+        dcap = 1.0 - (sc / softcap) ** 2
+    else:
+        sc = s
+        dcap = None
+    mask = _item_mask(meta_ref, w, q_base, k_base, bq, bk)
+
+    lse = lse_ref[:, 0]  # (bq,) f32
+    neg = jnp.isneginf(lse)
+    lse_safe = jnp.where(neg, 0.0, lse)
+    p = jnp.exp(jnp.where(mask, sc, NEG_INF) - lse_safe[:, None])
+    p = jnp.where(mask & ~neg[:, None], p, 0.0)
+
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[:, :1])
+    if dcap is not None:
+        ds = ds * dcap
+    ds = ds * scale
+    dq_scr[:] += jax.lax.dot_general(
+        ds.astype(q_ref.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(is_last == 1)
+    def _():
+        dq_ref[0] = dq_scr[:]
+
+
+def _ffa_bwd_dq_pallas(params: FFAParams, q_t, k_t, v_t, do_t, lse_t, delta_t):
+    plan = params.plan
+    bq, bk = plan.block_q, plan.block_k
+    hq, sqp, d = q_t.shape
+    _, _, dv = v_t.shape
+    g = params.group
+    W = plan.num_work
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(hq, W),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h // g, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h // g, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, dv), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )
+    kernel = partial(
+        _bwd_dq_kernel, scale=params.softmax_scale, softcap=params.softcap,
+        bq=bq, bk=bk,
+    )
+    (dq_t,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((hq, sqp, d), jnp.float32)],
+        interpret=params.interpret,
+    )(
+        jnp.asarray(plan.work_qt),
+        jnp.asarray(plan.work_kt),
+        jnp.asarray(plan.meta),
+        q_t, k_t, v_t, do_t, lse_t[..., None], delta_t[..., None],
+    )
+    return dq_t
+
+
+# ---------------------------------------------------------------------------
+# backward: dk/dv (k-major plan)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkv_kernel(
+    work_qt_ref,
+    work_kt_ref,
+    meta_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    scale: float,
+    softcap: float,
+    bq: int,
+    bk: int,
+):
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    q_base = work_qt_ref[w] * bq
+    k_base = work_kt_ref[w] * bk
+
+    @pl.when(is_first == 1)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    # s_t: (bk, bq) — k rows, q cols
+    s_t = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if softcap > 0.0:
+        sc_t = softcap * jnp.tanh(s_t / softcap)
+        dcap_t = 1.0 - (sc_t / softcap) ** 2
+    else:
+        sc_t = s_t
+        dcap_t = None
+    mask_t = _item_mask(meta_ref, w, q_base, k_base, bq, bk, transposed=True)
+
+    lse = lse_ref[:, 0]  # (bq,)
+    neg = jnp.isneginf(lse)
+    lse_safe = jnp.where(neg, 0.0, lse)
+    p_t = jnp.exp(jnp.where(mask_t, sc_t, NEG_INF) - lse_safe[None, :])
+    p_t = jnp.where(mask_t & ~neg[None, :], p_t, 0.0)
+
+    dv_scr[:] += jax.lax.dot_general(
+        p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp_t = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds_t = p_t * (dp_t - delta_ref[:, 0][None, :])
+    if dcap_t is not None:
+        ds_t = ds_t * dcap_t
+    ds_t = ds_t * scale
+    dk_scr[:] += jax.lax.dot_general(
+        ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(is_last == 1)
+    def _():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+def _ffa_bwd_dkv_pallas(params: FFAParams, q_t, k_t, v_t, do_t, lse_t, delta_t):
+    plan = params.plan
+    bq, bk = plan.block_q, plan.block_k
+    hq, sqp, d = q_t.shape
+    hk, skp, dv = v_t.shape
+    g = params.group
+    WT = plan.num_work_t
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(hq, WT),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h // g, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h // g, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, dv), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, dv), jnp.float32),
+        ],
+    )
+    kernel = partial(
+        _bwd_dkv_kernel, scale=params.softmax_scale, softcap=params.softcap,
+        bq=bq, bk=bk,
+    )
+    dk_t, dv_t = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hq, skp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hq, skp, dv), jnp.float32),
+        ],
+        interpret=params.interpret,
+    )(
+        jnp.asarray(plan.work_qt_t),
+        jnp.asarray(plan.work_kt_t),
+        jnp.asarray(plan.meta_t),
+        q_t, k_t, v_t, do_t, lse_t[..., None], delta_t[..., None],
+    )
+    return dk_t, dv_t
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom VJP)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ffa_core(q_t, k_t, v_t, params: FFAParams):
+    return _ffa_fwd_pallas(params, q_t, k_t, v_t)
+
+
+def _ffa_core_fwd(q_t, k_t, v_t, params: FFAParams):
+    out_t, lse_t = _ffa_fwd_pallas(params, q_t, k_t, v_t)
+    return (out_t, lse_t), (q_t, k_t, v_t, out_t, lse_t)
+
+
+def _ffa_core_bwd(params: FFAParams, res, cts):
+    # lse is an auxiliary output: its cotangent is ignored (the CP runtime
+    # differentiates the lse-merge manually, matching the reference).
+    do_t, _ = cts
+    q_t, k_t, v_t, out_t, lse_t = res
+    delta_t = jnp.sum(
+        do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
+    )  # (hq, sqp)
+    dq_t = _ffa_bwd_dq_pallas(params, q_t, k_t, v_t, do_t, lse_t, delta_t)
+    dk_t, dv_t = _ffa_bwd_dkv_pallas(params, q_t, k_t, v_t, do_t, lse_t, delta_t)
+    g = params.group
+    if g > 1:
+        hq, skp, d = dk_t.shape
+        dk_t = dk_t.reshape(hq // g, g, skp, d).sum(axis=1)
+        dv_t = dv_t.reshape(hq // g, g, skp, dv_t.shape[-1]).sum(axis=1)
+    return dq_t.astype(q_t.dtype), dk_t.astype(k_t.dtype), dv_t.astype(v_t.dtype)
+
+
+_ffa_core.defvjp(_ffa_core_fwd, _ffa_core_bwd)
+
+
+def _should_interpret() -> bool:
+    return (
+        env_general.is_interpret_mode_enable()
+        or jax.default_backend() == "cpu"
+    )
+
+
+def ffa_attn(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_ranges,
+    k_ranges,
+    attn_type_map,
+    softmax_scale: float | None = None,
+    softcap: float = 0.0,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    return_lse: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """Pallas FFA over slice metadata. Same contract as sdpa_attn.
+
+    The slice metadata must be *concrete* (host) values — it parameterizes the
+    kernel grid. Inside jit-traced code, close over it (the runtime manager
+    caches traced plans per mask, mirroring the reference's runtime LRU).
+    """
+    try:
+        qr = np.asarray(q_ranges, dtype=np.int32)
+        kr = np.asarray(k_ranges, dtype=np.int32)
+        tm = np.asarray(attn_type_map, dtype=np.int32)
+    except Exception as e:  # pragma: no cover
+        raise ValueError(
+            "ffa_attn requires concrete (host) slice metadata; inside jit, "
+            "close over the metadata or use the sdpa backends"
+        ) from e
+
+    sq, hq, d = q.shape
+    sk, hk, dv = v.shape
+    g = hq // hk
+    if softmax_scale is None:
+        softmax_scale = float(d) ** -0.5
+
+    bq = block_q or env_kernel.ffa_block_q()
+    bk = block_k or env_kernel.ffa_block_k()
+    bq = min(bq, _round_up(sq, 16))
+    bk = min(bk, _round_up(sk, 128))
+
+    plan = get_ffa_plan(qr, kr, tm, sq, sk, bq, bk)
+    params = FFAParams(
+        plan=plan,
+        softmax_scale=float(softmax_scale),
+        softcap=float(softcap),
+        group=g,
+        interpret=_should_interpret(),
+    )
+
+    sqp = plan.num_q_tiles * bq
+    skp = plan.num_k_tiles * bk
+    q_t = jnp.pad(q, ((0, sqp - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
+    k_t = jnp.pad(k, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
+    v_t = jnp.pad(v, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
+
+    out_t, lse_t = _ffa_core(q_t, k_t, v_t, params)
+    out = out_t.transpose(1, 0, 2)[:sq]
+    lse = lse_t.T[:sq]
+    return out, lse

@@ -1,0 +1,206 @@
+"""Host-side tiling plan for the Pallas FFA kernel.
+
+The TPU replacement for the reference's range-aware persistent tile schedulers
+(csrc/flexible_flash_attention/fwd_tile_scheduler.hpp, bwd_tile_scheduler.hpp):
+instead of a device-side scheduler walking (q_range, k_range, mask_type) lists,
+we precompute — on the host, from concrete slice metadata — the exact list of
+(q_tile, k_tile, slice) work items the kernel grid will visit. Fully-masked
+tiles are never visited; fully-unmasked tiles skip mask evaluation. This is the
+idiomatic TPU trade: static grids + scalar prefetch instead of dynamic
+scheduling + atomics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import numpy as np
+
+# meta columns per work item
+QS, QE, KS, KE, TYPE, IS_FIRST, IS_LAST, IS_FULL = range(8)
+META_DIM = 8
+
+
+@dataclass(frozen=True)
+class FFAPlan:
+    """A flat, q-tile-major work list plus its k-tile-major transpose."""
+
+    # q-major (forward + dq): runs of items grouped by q tile
+    work_qt: np.ndarray  # (W,) int32 — q tile index per item
+    work_kt: np.ndarray  # (W,) int32 — k tile index per item
+    meta: np.ndarray  # (W, META_DIM) int32
+    # k-major (dkv): runs of items grouped by k tile
+    work_qt_t: np.ndarray
+    work_kt_t: np.ndarray
+    meta_t: np.ndarray
+    num_q_tiles: int
+    num_k_tiles: int
+    block_q: int
+    block_k: int
+
+    @property
+    def num_work(self) -> int:
+        return len(self.work_qt)
+
+    @property
+    def num_work_t(self) -> int:
+        return len(self.work_qt_t)
+
+
+def _tile_slice_interaction(
+    i0: int, i1: int, j0: int, j1: int, qs: int, qe: int, ks: int, ke: int, t: int
+) -> tuple[bool, bool]:
+    """(nonempty, fully_unmasked) of slice-type t on rect [i0,i1) x [j0,j1).
+
+    The rect is already the intersection with the slice's q/k ranges.
+    Causal bound: j - i <= ke - qe. Inv bound: j - i >= ks - qs.
+    """
+    if i0 >= i1 or j0 >= j1:
+        return False, False
+    c = ke - qe
+    v = ks - qs
+    causal = t in (1, 3)
+    inv = t in (2, 3)
+    nonempty = True
+    full = True
+    if causal:
+        if j0 - (i1 - 1) > c:
+            nonempty = False
+        if j1 - 1 - i0 > c:
+            full = False
+    if inv:
+        if (j1 - 1) - i0 < v:
+            nonempty = False
+        if j0 - (i1 - 1) < v:
+            full = False
+    return nonempty, full and nonempty
+
+
+def build_ffa_plan(
+    q_ranges: np.ndarray,
+    k_ranges: np.ndarray,
+    attn_type_map: np.ndarray,
+    seqlen_q: int,
+    seqlen_k: int,
+    block_q: int,
+    block_k: int,
+) -> FFAPlan:
+    """Build the work-item lists for the given slice metadata."""
+    num_q_tiles = max(1, -(-seqlen_q // block_q))
+    num_k_tiles = max(1, -(-seqlen_k // block_k))
+
+    n = len(q_ranges)
+    # per-q-tile buckets
+    q_items: list[list[tuple[int, int, int, int, int, int, int]]] = [
+        [] for _ in range(num_q_tiles)
+    ]
+    k_items: list[list[tuple[int, int, int, int, int, int, int]]] = [
+        [] for _ in range(num_k_tiles)
+    ]
+
+    for s in range(n):
+        qs, qe = int(q_ranges[s, 0]), int(q_ranges[s, 1])
+        ks, ke = int(k_ranges[s, 0]), int(k_ranges[s, 1])
+        t = int(attn_type_map[s])
+        if qs >= qe or ks >= ke:
+            continue
+        qt_lo, qt_hi = qs // block_q, -(-qe // block_q)
+        kt_lo, kt_hi = ks // block_k, -(-ke // block_k)
+        for qt in range(qt_lo, qt_hi):
+            i0, i1 = max(qs, qt * block_q), min(qe, (qt + 1) * block_q)
+            for kt in range(kt_lo, kt_hi):
+                j0, j1 = max(ks, kt * block_k), min(ke, (kt + 1) * block_k)
+                nonempty, full = _tile_slice_interaction(
+                    i0, i1, j0, j1, qs, qe, ks, ke, t
+                )
+                if not nonempty:
+                    continue
+                # full-tile fast path additionally needs the rect to cover the
+                # whole hardware tile
+                tile_full = (
+                    full
+                    and i0 == qt * block_q
+                    and i1 == (qt + 1) * block_q
+                    and j0 == kt * block_k
+                    and j1 == (kt + 1) * block_k
+                )
+                item = (qt, kt, qs, qe, ks, ke, t, int(tile_full))
+                q_items[qt].append(item)
+                k_items[kt].append(item)
+
+    def flatten(buckets, major_is_q: bool):
+        work_a, work_b, metas = [], [], []
+        for tile_idx, items in enumerate(buckets):
+            if not items:
+                # dummy item: empty k range -> all-masked -> finalize writes
+                # zeros/-inf (fwd) or zero grads (bwd) for this tile
+                items = [(tile_idx if major_is_q else 0,
+                          0 if major_is_q else tile_idx,
+                          0, 0, 0, 0, 0, 0)]
+            for pos, (qt, kt, qs, qe, ks, ke, t, full) in enumerate(items):
+                m = np.zeros(META_DIM, dtype=np.int32)
+                m[QS], m[QE], m[KS], m[KE], m[TYPE] = qs, qe, ks, ke, t
+                m[IS_FIRST] = 1 if pos == 0 else 0
+                m[IS_LAST] = 1 if pos == len(items) - 1 else 0
+                m[IS_FULL] = full
+                work_a.append(qt)
+                work_b.append(kt)
+                metas.append(m)
+        return (
+            np.asarray(work_a, dtype=np.int32),
+            np.asarray(work_b, dtype=np.int32),
+            np.stack(metas).astype(np.int32),
+        )
+
+    work_qt, work_kt, meta = flatten(q_items, major_is_q=True)
+    work_qt_t, work_kt_t, meta_t = flatten(k_items, major_is_q=False)
+
+    return FFAPlan(
+        work_qt=work_qt,
+        work_kt=work_kt,
+        meta=meta,
+        work_qt_t=work_qt_t,
+        work_kt_t=work_kt_t,
+        meta_t=meta_t,
+        num_q_tiles=num_q_tiles,
+        num_k_tiles=num_k_tiles,
+        block_q=block_q,
+        block_k=block_k,
+    )
+
+
+@lru_cache(maxsize=256)
+def _cached_plan(
+    qr_bytes: bytes,
+    kr_bytes: bytes,
+    tm_bytes: bytes,
+    n: int,
+    seqlen_q: int,
+    seqlen_k: int,
+    block_q: int,
+    block_k: int,
+) -> FFAPlan:
+    qr = np.frombuffer(qr_bytes, dtype=np.int32).reshape(n, 2)
+    kr = np.frombuffer(kr_bytes, dtype=np.int32).reshape(n, 2)
+    tm = np.frombuffer(tm_bytes, dtype=np.int32)
+    return build_ffa_plan(qr, kr, tm, seqlen_q, seqlen_k, block_q, block_k)
+
+
+def get_ffa_plan(
+    q_ranges: np.ndarray,
+    k_ranges: np.ndarray,
+    attn_type_map: np.ndarray,
+    seqlen_q: int,
+    seqlen_k: int,
+    block_q: int,
+    block_k: int,
+) -> FFAPlan:
+    """LRU-cached plan lookup keyed by the full metadata contents."""
+    qr = np.ascontiguousarray(q_ranges, dtype=np.int32)
+    kr = np.ascontiguousarray(k_ranges, dtype=np.int32)
+    tm = np.ascontiguousarray(attn_type_map, dtype=np.int32)
+    return _cached_plan(
+        qr.tobytes(), kr.tobytes(), tm.tobytes(), len(qr),
+        seqlen_q, seqlen_k, block_q, block_k,
+    )

@@ -1,0 +1,74 @@
+"""Traced mask construction from AttnSlice metadata arrays.
+
+The device-side counterpart of ``common.mask`` (ref kernel contract:
+magi_attention/functional/flex_flash_attn.py:1454-1466): slice metadata is
+``q_ranges (N,2) int32``, ``k_ranges (N,2) int32``, ``attn_type_map (N,)
+int32`` with 0=FULL, 1=CAUSAL, 2=INVCAUSAL, 3=BICAUSAL. Empty slices
+(``q_start >= q_end``) are padding and contribute nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def slice_block_mask(
+    q_start,
+    q_end,
+    k_start,
+    k_end,
+    mask_type,
+    q_index,
+    k_index,
+):
+    """Boolean mask contribution of one slice on a (len(q_index), len(k_index))
+    tile of global coordinates.
+
+    Geometry (d = j - i): CAUSAL: d <= k_end - q_end (bottom-right aligned);
+    INVCAUSAL: d >= k_start - q_start (top-left aligned); BICAUSAL: both.
+    """
+    i = q_index[:, None]
+    j = k_index[None, :]
+    in_rect = (i >= q_start) & (i < q_end) & (j >= k_start) & (j < k_end)
+    d = j - i
+    causal_ok = d <= (k_end - q_end)
+    inv_ok = d >= (k_start - q_start)
+    ok = jnp.where(
+        mask_type == 0,
+        True,
+        jnp.where(
+            mask_type == 1,
+            causal_ok,
+            jnp.where(mask_type == 2, inv_ok, causal_ok & inv_ok),
+        ),
+    )
+    return in_rect & ok
+
+
+def build_dense_mask(
+    q_ranges: jax.Array,
+    k_ranges: jax.Array,
+    attn_type_map: jax.Array,
+    seqlen_q: int,
+    seqlen_k: int,
+    q_offset: int = 0,
+    k_offset: int = 0,
+) -> jax.Array:
+    """Materialize the (seqlen_q, seqlen_k) boolean mask from slice metadata.
+
+    ``q_offset``/``k_offset`` shift the local tile into global coordinates
+    (used by the blockwise backends). O(N * sq * sk) work via scan — testing /
+    fallback path only; the Pallas kernel never materializes this.
+    """
+    q_index = q_offset + jnp.arange(seqlen_q, dtype=jnp.int32)
+    k_index = k_offset + jnp.arange(seqlen_k, dtype=jnp.int32)
+
+    def body(mask, slice_meta):
+        qr, kr, mt = slice_meta
+        contrib = slice_block_mask(qr[0], qr[1], kr[0], kr[1], mt, q_index, k_index)
+        return mask | contrib, None
+
+    init = jnp.zeros((seqlen_q, seqlen_k), dtype=jnp.bool_)
+    mask, _ = jax.lax.scan(body, init, (q_ranges, k_ranges, attn_type_map))
+    return mask

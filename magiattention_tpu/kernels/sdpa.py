@@ -1,0 +1,66 @@
+"""Dense jnp SDPA backend replaying AttnSlice metadata.
+
+The numerical fake-backend substitute for the Pallas kernel (mirrors the
+reference's sdpa backend strategy, magi_attention/functional/sdpa.py): same
+``AttnArg`` contract, fp32/fp64 dense compute, differentiable via jax AD.
+Testing / small-seqlen only — O(sq*sk) memory.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mask_utils import build_dense_mask
+
+NEG_INF = float("-inf")
+
+
+def sdpa_attn(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_ranges: jax.Array,
+    k_ranges: jax.Array,
+    attn_type_map: jax.Array,
+    softmax_scale: float | None = None,
+    softcap: float = 0.0,
+    compute_dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """Compute flex attention densely.
+
+    Args:
+        q: ``[sq, hq, d]`` queries (varlen packed layout, no batch dim).
+        k: ``[sk, hk, d]`` keys; ``hq % hk == 0`` (GQA).
+        v: ``[sk, hk, dv]`` values.
+        q_ranges/k_ranges/attn_type_map: slice metadata arrays (N,2)/(N,2)/(N,).
+
+    Returns:
+        out ``[sq, hq, dv]`` in q.dtype, lse ``[sq, hq]`` fp32 (natural log;
+        ``-inf`` on fully-masked rows, whose out is 0).
+    """
+    sq, hq, d = q.shape
+    sk, hk, dv = v.shape
+    g = hq // hk
+    if softmax_scale is None:
+        softmax_scale = d ** -0.5
+
+    mask = build_dense_mask(q_ranges, k_ranges, attn_type_map, sq, sk)
+
+    qc = q.astype(compute_dtype)
+    kc = jnp.repeat(k.astype(compute_dtype), g, axis=1)  # [sk, hq, d]
+    vc = jnp.repeat(v.astype(compute_dtype), g, axis=1)  # [sk, hq, dv]
+
+    # [hq, sq, sk]
+    logits = jnp.einsum("qhd,khd->hqk", qc, kc) * softmax_scale
+    if softcap > 0.0:
+        logits = softcap * jnp.tanh(logits / softcap)
+    logits = jnp.where(mask[None, :, :], logits, NEG_INF)
+
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [hq, sq]
+    # fully-masked rows: lse = -inf; make softmax output exact zeros
+    p = jnp.exp(logits - jnp.where(jnp.isfinite(lse), lse, 0.0)[..., None])
+    p = jnp.where(mask[None, :, :], p, 0.0)
+    out = jnp.einsum("hqk,khd->qhd", p, vc)
+
+    return out.astype(q.dtype), lse.T.astype(jnp.float32)

@@ -1,0 +1,82 @@
+"""Blockwise-online jnp SDPA backend (low-memory testing path).
+
+Ref: magi_attention/functional/sdpa_online.py — replays the same AttnArg
+contract with an online-softmax scan over key blocks; exercises exactly the
+merge math the Pallas kernel and the CP lse-reduce use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mask_utils import build_dense_mask
+
+NEG_INF = float("-inf")
+
+
+def sdpa_online_attn(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_ranges: jax.Array,
+    k_ranges: jax.Array,
+    attn_type_map: jax.Array,
+    softmax_scale: float | None = None,
+    softcap: float = 0.0,
+    block_k: int = 512,
+    compute_dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """Same contract as :func:`kernels.sdpa.sdpa_attn`, O(sq*block_k) memory."""
+    sq, hq, d = q.shape
+    sk, hk, dv = v.shape
+    g = hq // hk
+    if softmax_scale is None:
+        softmax_scale = d ** -0.5
+
+    num_blocks = -(-sk // block_k)
+    sk_pad = num_blocks * block_k
+
+    qc = q.astype(compute_dtype)
+    kc = jnp.repeat(k.astype(compute_dtype), g, axis=1)
+    vc = jnp.repeat(v.astype(compute_dtype), g, axis=1)
+    kc = jnp.pad(kc, ((0, sk_pad - sk), (0, 0), (0, 0)))
+    vc = jnp.pad(vc, ((0, sk_pad - sk), (0, 0), (0, 0)))
+    kc = kc.reshape(num_blocks, block_k, hq, d)
+    vc = vc.reshape(num_blocks, block_k, hq, dv)
+
+    def body(carry, blk):
+        m, l, acc = carry  # [hq,sq], [hq,sq], [sq,hq,dv]
+        kb, vb, blk_idx = blk
+        logits = jnp.einsum("qhd,khd->hqk", qc, kb) * softmax_scale  # [hq,sq,bk]
+        if softcap > 0.0:
+            logits = softcap * jnp.tanh(logits / softcap)
+        k_off = blk_idx * block_k
+        mask = build_dense_mask(
+            q_ranges, k_ranges, attn_type_map, sq, block_k, k_offset=k_off
+        )
+        # padding cols beyond sk are masked automatically (k >= every k_range end)
+        logits = jnp.where(mask[None], logits, NEG_INF)
+
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.exp(m - m_safe)  # 0 where m was -inf
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(mask[None], p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha.T[..., None] + jnp.einsum("hqk,khd->qhd", p, vb)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((hq, sq), NEG_INF, dtype=compute_dtype)
+    l0 = jnp.zeros((hq, sq), dtype=compute_dtype)
+    acc0 = jnp.zeros((sq, hq, dv), dtype=compute_dtype)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0), (kc, vc, jnp.arange(num_blocks, dtype=jnp.int32))
+    )
+
+    empty = l == 0.0
+    lse = jnp.where(empty, NEG_INF, m + jnp.log(jnp.where(empty, 1.0, l)))
+    out = acc / jnp.where(empty, 1.0, l).T[..., None]
+    out = jnp.where(empty.T[..., None], 0.0, out)
+    return out.astype(q.dtype), lse.T.astype(jnp.float32)

@@ -1,0 +1,4 @@
+"""Testing support: golden reference attention + precision asserts."""
+
+from .precision import assert_close  # noqa: F401
+from .ref_attn import ref_attn  # noqa: F401

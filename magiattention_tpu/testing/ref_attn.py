@@ -1,0 +1,59 @@
+"""Ground-truth attention (ref: magi_attention/testing/ref_attn.py:41-638).
+
+A dense fp64 (fp32 on TPU) masked-SDPA over an *explicit* boolean mask —
+independent of the slice-metadata machinery, so it cross-checks both the mask
+construction and the kernels. Differentiable with jax AD.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = float("-inf")
+
+
+def ref_attn(
+    q,
+    k,
+    v,
+    mask: np.ndarray,
+    softmax_scale: float | None = None,
+    softcap: float = 0.0,
+    compute_dtype=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Dense reference attention.
+
+    Args:
+        q/k/v: ``[sq,hq,d] / [sk,hk,d] / [sk,hk,dv]`` (varlen packed layout).
+        mask: ``[sq, sk]`` boolean numpy array (True = attend).
+
+    Returns:
+        (out ``[sq,hq,dv]`` in q.dtype, lse ``[sq,hq]`` fp32).
+    """
+    if compute_dtype is None:
+        compute_dtype = (
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        )
+    sq, hq, d = q.shape
+    sk, hk, dv = v.shape
+    g = hq // hk
+    if softmax_scale is None:
+        softmax_scale = d ** -0.5
+
+    qc = jnp.asarray(q, dtype=compute_dtype)
+    kc = jnp.repeat(jnp.asarray(k, dtype=compute_dtype), g, axis=1)
+    vc = jnp.repeat(jnp.asarray(v, dtype=compute_dtype), g, axis=1)
+    maskj = jnp.asarray(np.asarray(mask))
+
+    logits = jnp.einsum("qhd,khd->hqk", qc, kc) * softmax_scale
+    if softcap > 0.0:
+        logits = softcap * jnp.tanh(logits / softcap)
+    logits = jnp.where(maskj[None], logits, NEG_INF)
+
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [hq, sq]
+    p = jnp.exp(logits - jnp.where(jnp.isfinite(lse), lse, 0.0)[..., None])
+    p = jnp.where(maskj[None], p, 0.0)
+    out = jnp.einsum("hqk,khd->qhd", p, vc)
+    return out.astype(q.dtype), lse.T.astype(jnp.float32)

@@ -4,14 +4,21 @@ Mirrors the reference's no-cluster strategy (testing/dist_common.py spawns N
 local processes); on TPU/JAX the idiomatic substitute is
 ``xla_force_host_platform_device_count`` + ``shard_map`` in a single process.
 Pallas kernels run in interpreter mode on CPU.
+
+NOTE: the axon TPU plugin force-sets JAX_PLATFORMS=axon from sitecustomize, so
+plain env vars are not enough — we must override via jax.config before any
+backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("MAGI_ATTENTION_PALLAS_INTERPRET", "1")
+os.environ["MAGI_ATTENTION_PALLAS_INTERPRET"] = "1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
