@@ -33,7 +33,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..env import general as env_general
 from ..env import kernel as env_kernel
-from .ffa_plan import IS_FIRST, IS_LAST, KE, KS, QE, QS, TYPE, FFAPlan, get_ffa_plan
+from .ffa_plan import (  # noqa: F401
+    DHI,
+    DLO,
+    IS_FIRST,
+    IS_LAST,
+    KE,
+    KS,
+    QE,
+    QS,
+    FFAPlan,
+    get_ffa_plan,
+)
+from .mask_utils import types_to_bands
 
 NEG_INF = float("-inf")
 
@@ -63,7 +75,7 @@ def _item_mask(
     """
     qs, qe = meta_ref[w, QS], meta_ref[w, QE]
     ks, ke = meta_ref[w, KS], meta_ref[w, KE]
-    t = meta_ref[w, TYPE]
+    lo, hi = meta_ref[w, DLO], meta_ref[w, DHI]
     if transposed:
         rows = q_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
         cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
@@ -72,16 +84,7 @@ def _item_mask(
         cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     in_rect = (rows >= qs) & (rows < qe) & (cols >= ks) & (cols < ke)
     d = cols - rows
-    causal_ok = d <= (ke - qe)
-    inv_ok = d >= (ks - qs)
-    # scalar type flags combined via boolean algebra (Mosaic cannot select on
-    # i1 vectors): CAUSAL/BICAUSAL impose causal_ok, INVCAUSAL/BICAUSAL inv_ok
-    is_causal = (t == 1) | (t == 3)
-    is_inv = (t == 2) | (t == 3)
-    ok = (jnp.logical_not(is_causal) | causal_ok) & (
-        jnp.logical_not(is_inv) | inv_ok
-    )
-    return in_rect & ok
+    return in_rect & (d >= lo) & (d <= hi)
 
 
 # ---------------------------------------------------------------------------
@@ -540,23 +543,35 @@ def ffa_attn(
     v: jax.Array,
     q_ranges,
     k_ranges,
-    attn_type_map,
+    attn_type_map=None,
     softmax_scale: float | None = None,
     softcap: float = 0.0,
     block_q: int | None = None,
     block_k: int | None = None,
-    return_lse: bool = True,
+    d_lo=None,
+    d_hi=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Pallas FFA over slice metadata. Same contract as sdpa_attn.
 
-    The slice metadata must be *concrete* (host) values — it parameterizes the
-    kernel grid. Inside jit-traced code, close over it (the runtime manager
-    caches traced plans per mask, mirroring the reference's runtime LRU).
+    Slices may be given as mask types (``attn_type_map``) or directly as
+    diagonal bands (``d_lo``/``d_hi``). The metadata must be *concrete*
+    (host) values — it parameterizes the kernel grid. Inside jit-traced code,
+    close over it (the runtime manager caches traced plans per mask,
+    mirroring the reference's runtime LRU).
     """
     try:
         qr = np.asarray(q_ranges, dtype=np.int32)
         kr = np.asarray(k_ranges, dtype=np.int32)
-        tm = np.asarray(attn_type_map, dtype=np.int32)
+        if d_lo is None or d_hi is None:
+            tm = (
+                np.zeros(len(qr), dtype=np.int32)
+                if attn_type_map is None
+                else np.asarray(attn_type_map, dtype=np.int32)
+            )
+            d_lo, d_hi = types_to_bands(qr, kr, tm)
+        else:
+            d_lo = np.asarray(d_lo, dtype=np.int32)
+            d_hi = np.asarray(d_hi, dtype=np.int32)
     except Exception as e:  # pragma: no cover
         raise ValueError(
             "ffa_attn requires concrete (host) slice metadata; inside jit, "
@@ -574,7 +589,7 @@ def ffa_attn(
     bq = min(bq, _round_up(sq, 16))
     bk = min(bk, _round_up(sk, 128))
 
-    plan = get_ffa_plan(qr, kr, tm, sq, sk, bq, bk)
+    plan = get_ffa_plan(qr, kr, d_lo, d_hi, sq, sk, bq, bk)
     params = FFAParams(
         plan=plan,
         softmax_scale=float(softmax_scale),

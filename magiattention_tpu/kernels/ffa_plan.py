@@ -5,9 +5,12 @@ The TPU replacement for the reference's range-aware persistent tile schedulers
 instead of a device-side scheduler walking (q_range, k_range, mask_type) lists,
 we precompute — on the host, from concrete slice metadata — the exact list of
 (q_tile, k_tile, slice) work items the kernel grid will visit. Fully-masked
-tiles are never visited; fully-unmasked tiles skip mask evaluation. This is the
-idiomatic TPU trade: static grids + scalar prefetch instead of dynamic
+tiles are never visited; fully-unmasked tiles can skip mask evaluation. This is
+the idiomatic TPU trade: static grids + scalar prefetch instead of dynamic
 scheduling + atomics.
+
+Slices are encoded as diagonal bands (q_range, k_range, d_lo <= j-i <= d_hi) —
+see kernels/mask_utils.types_to_bands.
 """
 
 from __future__ import annotations
@@ -17,12 +20,14 @@ from functools import lru_cache
 
 import numpy as np
 
+from .mask_utils import BAND_INF
+
 # meta columns per work item
-QS, QE, KS, KE, TYPE, IS_FIRST, IS_LAST, IS_FULL = range(8)
-META_DIM = 8
+QS, QE, KS, KE, DLO, DHI, IS_FIRST, IS_LAST, IS_FULL = range(9)
+META_DIM = 9
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=False)
 class FFAPlan:
     """A flat, q-tile-major work list plus its k-tile-major transpose."""
 
@@ -48,62 +53,42 @@ class FFAPlan:
         return len(self.work_qt_t)
 
 
-def _tile_slice_interaction(
-    i0: int, i1: int, j0: int, j1: int, qs: int, qe: int, ks: int, ke: int, t: int
+def _band_tile_interaction(
+    i0: int, i1: int, j0: int, j1: int, lo: int, hi: int
 ) -> tuple[bool, bool]:
-    """(nonempty, fully_unmasked) of slice-type t on rect [i0,i1) x [j0,j1).
-
-    The rect is already the intersection with the slice's q/k ranges.
-    Causal bound: j - i <= ke - qe. Inv bound: j - i >= ks - qs.
-    """
+    """(nonempty, fully_unmasked) of band [lo, hi] on rect [i0,i1) x [j0,j1)."""
     if i0 >= i1 or j0 >= j1:
         return False, False
-    c = ke - qe
-    v = ks - qs
-    causal = t in (1, 3)
-    inv = t in (2, 3)
-    nonempty = True
-    full = True
-    if causal:
-        if j0 - (i1 - 1) > c:
-            nonempty = False
-        if j1 - 1 - i0 > c:
-            full = False
-    if inv:
-        if (j1 - 1) - i0 < v:
-            nonempty = False
-        if j0 - (i1 - 1) < v:
-            full = False
-    return nonempty, full and nonempty
+    d_min = j0 - (i1 - 1)
+    d_max = (j1 - 1) - i0
+    nonempty = d_min <= hi and d_max >= lo
+    full = nonempty and d_max <= hi and d_min >= lo
+    return nonempty, full
 
 
 def build_ffa_plan(
     q_ranges: np.ndarray,
     k_ranges: np.ndarray,
-    attn_type_map: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
     seqlen_q: int,
     seqlen_k: int,
     block_q: int,
     block_k: int,
 ) -> FFAPlan:
-    """Build the work-item lists for the given slice metadata."""
+    """Build the work-item lists for the given band-slice metadata."""
     num_q_tiles = max(1, -(-seqlen_q // block_q))
     num_k_tiles = max(1, -(-seqlen_k // block_k))
 
     n = len(q_ranges)
-    # per-q-tile buckets
-    q_items: list[list[tuple[int, int, int, int, int, int, int]]] = [
-        [] for _ in range(num_q_tiles)
-    ]
-    k_items: list[list[tuple[int, int, int, int, int, int, int]]] = [
-        [] for _ in range(num_k_tiles)
-    ]
+    q_items: list[list[tuple[int, ...]]] = [[] for _ in range(num_q_tiles)]
+    k_items: list[list[tuple[int, ...]]] = [[] for _ in range(num_k_tiles)]
 
     for s in range(n):
         qs, qe = int(q_ranges[s, 0]), int(q_ranges[s, 1])
         ks, ke = int(k_ranges[s, 0]), int(k_ranges[s, 1])
-        t = int(attn_type_map[s])
-        if qs >= qe or ks >= ke:
+        lo, hi = int(d_lo[s]), int(d_hi[s])
+        if qs >= qe or ks >= ke or lo > hi:
             continue
         qt_lo, qt_hi = qs // block_q, -(-qe // block_q)
         kt_lo, kt_hi = ks // block_k, -(-ke // block_k)
@@ -111,13 +96,9 @@ def build_ffa_plan(
             i0, i1 = max(qs, qt * block_q), min(qe, (qt + 1) * block_q)
             for kt in range(kt_lo, kt_hi):
                 j0, j1 = max(ks, kt * block_k), min(ke, (kt + 1) * block_k)
-                nonempty, full = _tile_slice_interaction(
-                    i0, i1, j0, j1, qs, qe, ks, ke, t
-                )
+                nonempty, full = _band_tile_interaction(i0, i1, j0, j1, lo, hi)
                 if not nonempty:
                     continue
-                # full-tile fast path additionally needs the rect to cover the
-                # whole hardware tile
                 tile_full = (
                     full
                     and i0 == qt * block_q
@@ -125,7 +106,7 @@ def build_ffa_plan(
                     and j0 == kt * block_k
                     and j1 == (kt + 1) * block_k
                 )
-                item = (qt, kt, qs, qe, ks, ke, t, int(tile_full))
+                item = (qt, kt, qs, qe, ks, ke, lo, hi, int(tile_full))
                 q_items[qt].append(item)
                 k_items[kt].append(item)
 
@@ -135,12 +116,17 @@ def build_ffa_plan(
             if not items:
                 # dummy item: empty k range -> all-masked -> finalize writes
                 # zeros/-inf (fwd) or zero grads (bwd) for this tile
-                items = [(tile_idx if major_is_q else 0,
-                          0 if major_is_q else tile_idx,
-                          0, 0, 0, 0, 0, 0)]
-            for pos, (qt, kt, qs, qe, ks, ke, t, full) in enumerate(items):
+                items = [
+                    (
+                        tile_idx if major_is_q else 0,
+                        0 if major_is_q else tile_idx,
+                        0, 0, 0, 0, -BAND_INF, BAND_INF, 0,
+                    )
+                ]
+            for pos, (qt, kt, qs, qe, ks, ke, lo, hi, full) in enumerate(items):
                 m = np.zeros(META_DIM, dtype=np.int32)
-                m[QS], m[QE], m[KS], m[KE], m[TYPE] = qs, qe, ks, ke, t
+                m[QS], m[QE], m[KS], m[KE] = qs, qe, ks, ke
+                m[DLO], m[DHI] = lo, hi
                 m[IS_FIRST] = 1 if pos == 0 else 0
                 m[IS_LAST] = 1 if pos == len(items) - 1 else 0
                 m[IS_FULL] = full
@@ -170,11 +156,46 @@ def build_ffa_plan(
     )
 
 
+def pad_plan(plan: FFAPlan, num_work: int, num_work_t: int) -> FFAPlan:
+    """Pad work lists with no-op items (same tile as the last real item,
+    is_first=is_last=0, empty ranges) so plans from different CP ranks share
+    one static shape and can be fed to the kernel as traced arrays."""
+
+    def pad(work_a, work_b, meta, target, tile_col_is_q: bool):
+        w = len(work_a)
+        if w > target:
+            raise ValueError(f"plan has {w} items > target {target}")
+        if w == target:
+            return work_a, work_b, meta
+        pad_n = target - w
+        pa = np.full(pad_n, work_a[-1], dtype=np.int32)
+        pb = np.full(pad_n, work_b[-1], dtype=np.int32)
+        pm = np.zeros((pad_n, META_DIM), dtype=np.int32)
+        pm[:, DLO], pm[:, DHI] = -BAND_INF, BAND_INF
+        return (
+            np.concatenate([work_a, pa]),
+            np.concatenate([work_b, pb]),
+            np.concatenate([meta, pm]),
+        )
+
+    wq, wk, m = pad(plan.work_qt, plan.work_kt, plan.meta, num_work, True)
+    wqt, wkt, mt = pad(
+        plan.work_qt_t, plan.work_kt_t, plan.meta_t, num_work_t, False
+    )
+    return FFAPlan(
+        work_qt=wq, work_kt=wk, meta=m,
+        work_qt_t=wqt, work_kt_t=wkt, meta_t=mt,
+        num_q_tiles=plan.num_q_tiles, num_k_tiles=plan.num_k_tiles,
+        block_q=plan.block_q, block_k=plan.block_k,
+    )
+
+
 @lru_cache(maxsize=256)
 def _cached_plan(
     qr_bytes: bytes,
     kr_bytes: bytes,
-    tm_bytes: bytes,
+    lo_bytes: bytes,
+    hi_bytes: bytes,
     n: int,
     seqlen_q: int,
     seqlen_k: int,
@@ -183,14 +204,16 @@ def _cached_plan(
 ) -> FFAPlan:
     qr = np.frombuffer(qr_bytes, dtype=np.int32).reshape(n, 2)
     kr = np.frombuffer(kr_bytes, dtype=np.int32).reshape(n, 2)
-    tm = np.frombuffer(tm_bytes, dtype=np.int32)
-    return build_ffa_plan(qr, kr, tm, seqlen_q, seqlen_k, block_q, block_k)
+    lo = np.frombuffer(lo_bytes, dtype=np.int32)
+    hi = np.frombuffer(hi_bytes, dtype=np.int32)
+    return build_ffa_plan(qr, kr, lo, hi, seqlen_q, seqlen_k, block_q, block_k)
 
 
 def get_ffa_plan(
     q_ranges: np.ndarray,
     k_ranges: np.ndarray,
-    attn_type_map: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
     seqlen_q: int,
     seqlen_k: int,
     block_q: int,
@@ -199,8 +222,9 @@ def get_ffa_plan(
     """LRU-cached plan lookup keyed by the full metadata contents."""
     qr = np.ascontiguousarray(q_ranges, dtype=np.int32)
     kr = np.ascontiguousarray(k_ranges, dtype=np.int32)
-    tm = np.ascontiguousarray(attn_type_map, dtype=np.int32)
+    lo = np.ascontiguousarray(d_lo, dtype=np.int32)
+    hi = np.ascontiguousarray(d_hi, dtype=np.int32)
     return _cached_plan(
-        qr.tobytes(), kr.tobytes(), tm.tobytes(), len(qr),
+        qr.tobytes(), kr.tobytes(), lo.tobytes(), hi.tobytes(), len(qr),
         seqlen_q, seqlen_k, block_q, block_k,
     )

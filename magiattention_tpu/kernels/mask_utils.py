@@ -1,10 +1,16 @@
 """Traced mask construction from AttnSlice metadata arrays.
 
-The device-side counterpart of ``common.mask`` (ref kernel contract:
-magi_attention/functional/flex_flash_attn.py:1454-1466): slice metadata is
+Device-side counterpart of ``common.mask`` (ref kernel contract:
+magi_attention/functional/flex_flash_attn.py:1454-1466). Public metadata is
 ``q_ranges (N,2) int32``, ``k_ranges (N,2) int32``, ``attn_type_map (N,)
-int32`` with 0=FULL, 1=CAUSAL, 2=INVCAUSAL, 3=BICAUSAL. Empty slices
-(``q_start >= q_end``) are padding and contribute nothing.
+int32`` with 0=FULL, 1=CAUSAL, 2=INVCAUSAL, 3=BICAUSAL.
+
+Internally every slice is normalized to an explicit diagonal band
+``d_lo <= j - i <= d_hi`` (the reference's AttnRectangle d_range geometry,
+common/rectangle.py:60-82): types only bound the band at construction time,
+after which clipping slices in q or k — which the CP planner does constantly —
+never changes the band. Empty slices (``q_start >= q_end``) are padding and
+contribute nothing.
 """
 
 from __future__ import annotations
@@ -12,38 +18,77 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# sentinel band bound: wide enough to be unbounded for any real seqlen,
+# small enough that int32 arithmetic with coordinates cannot overflow
+BAND_INF = 1 << 30
 
-def slice_block_mask(
-    q_start,
-    q_end,
-    k_start,
-    k_end,
-    mask_type,
-    q_index,
-    k_index,
-):
-    """Boolean mask contribution of one slice on a (len(q_index), len(k_index))
-    tile of global coordinates.
 
-    Geometry (d = j - i): CAUSAL: d <= k_end - q_end (bottom-right aligned);
-    INVCAUSAL: d >= k_start - q_start (top-left aligned); BICAUSAL: both.
+def types_to_bands(q_ranges, k_ranges, attn_type_map):
+    """Convert (q_range, k_range, mask_type) to diagonal band bounds.
+
+    Works on numpy or jnp arrays. Geometry (d = j - i, global coords):
+      CAUSAL:    d <= k_end - q_end      (bottom-right aligned)
+      INVCAUSAL: d >= k_start - q_start  (top-left aligned)
+      BICAUSAL:  both;  FULL: unbounded.
+
+    Returns:
+        (d_lo, d_hi) int32 arrays of shape (N,).
     """
+    t = attn_type_map
+    is_causal = (t == 1) | (t == 3)
+    is_inv = (t == 2) | (t == 3)
+    hi_bound = k_ranges[:, 1] - q_ranges[:, 1]
+    lo_bound = k_ranges[:, 0] - q_ranges[:, 0]
+    if hasattr(t, "device"):  # jnp
+        d_hi = jnp.where(is_causal, hi_bound, BAND_INF).astype(jnp.int32)
+        d_lo = jnp.where(is_inv, lo_bound, -BAND_INF).astype(jnp.int32)
+    else:
+        import numpy as np
+
+        d_hi = np.where(is_causal, hi_bound, BAND_INF).astype(np.int32)
+        d_lo = np.where(is_inv, lo_bound, -BAND_INF).astype(np.int32)
+    return d_lo, d_hi
+
+
+def slice_block_mask_band(
+    q_start, q_end, k_start, k_end, d_lo, d_hi, q_index, k_index
+):
+    """Boolean mask contribution of one band slice on a coordinate tile."""
     i = q_index[:, None]
     j = k_index[None, :]
     in_rect = (i >= q_start) & (i < q_end) & (j >= k_start) & (j < k_end)
     d = j - i
-    causal_ok = d <= (k_end - q_end)
-    inv_ok = d >= (k_start - q_start)
-    ok = jnp.where(
-        mask_type == 0,
-        True,
-        jnp.where(
-            mask_type == 1,
-            causal_ok,
-            jnp.where(mask_type == 2, inv_ok, causal_ok & inv_ok),
-        ),
-    )
-    return in_rect & ok
+    return in_rect & (d >= d_lo) & (d <= d_hi)
+
+
+def build_dense_mask_band(
+    q_ranges: jax.Array,
+    k_ranges: jax.Array,
+    d_lo: jax.Array,
+    d_hi: jax.Array,
+    seqlen_q: int,
+    seqlen_k: int,
+    q_offset: int = 0,
+    k_offset: int = 0,
+) -> jax.Array:
+    """Materialize the (seqlen_q, seqlen_k) boolean mask from band slices.
+
+    O(N * sq * sk) via scan — testing / fallback path only; the Pallas kernel
+    never materializes this.
+    """
+    q_index = q_offset + jnp.arange(seqlen_q, dtype=jnp.int32)
+    k_index = k_offset + jnp.arange(seqlen_k, dtype=jnp.int32)
+
+    def body(mask, slice_meta):
+        qr, kr, lo, hi = slice_meta
+        contrib = slice_block_mask_band(
+            qr[0], qr[1], kr[0], kr[1], lo, hi, q_index, k_index
+        )
+        return mask | contrib, None
+
+    init = jnp.zeros((seqlen_q, seqlen_k), dtype=jnp.bool_)
+    mask, _ = jax.lax.scan(body, init, (q_ranges, k_ranges, d_lo, d_hi))
+    return mask
 
 
 def build_dense_mask(
@@ -55,20 +100,8 @@ def build_dense_mask(
     q_offset: int = 0,
     k_offset: int = 0,
 ) -> jax.Array:
-    """Materialize the (seqlen_q, seqlen_k) boolean mask from slice metadata.
-
-    ``q_offset``/``k_offset`` shift the local tile into global coordinates
-    (used by the blockwise backends). O(N * sq * sk) work via scan — testing /
-    fallback path only; the Pallas kernel never materializes this.
-    """
-    q_index = q_offset + jnp.arange(seqlen_q, dtype=jnp.int32)
-    k_index = k_offset + jnp.arange(seqlen_k, dtype=jnp.int32)
-
-    def body(mask, slice_meta):
-        qr, kr, mt = slice_meta
-        contrib = slice_block_mask(qr[0], qr[1], kr[0], kr[1], mt, q_index, k_index)
-        return mask | contrib, None
-
-    init = jnp.zeros((seqlen_q, seqlen_k), dtype=jnp.bool_)
-    mask, _ = jax.lax.scan(body, init, (q_ranges, k_ranges, attn_type_map))
-    return mask
+    """Type-based convenience wrapper over :func:`build_dense_mask_band`."""
+    d_lo, d_hi = types_to_bands(q_ranges, k_ranges, attn_type_map)
+    return build_dense_mask_band(
+        q_ranges, k_ranges, d_lo, d_hi, seqlen_q, seqlen_k, q_offset, k_offset
+    )

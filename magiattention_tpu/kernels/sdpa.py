@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .mask_utils import build_dense_mask
+from .mask_utils import build_dense_mask_band, types_to_bands
 
 NEG_INF = float("-inf")
 
@@ -22,9 +22,11 @@ def sdpa_attn(
     v: jax.Array,
     q_ranges: jax.Array,
     k_ranges: jax.Array,
-    attn_type_map: jax.Array,
+    attn_type_map: jax.Array | None = None,
     softmax_scale: float | None = None,
     softcap: float = 0.0,
+    d_lo: jax.Array | None = None,
+    d_hi: jax.Array | None = None,
     compute_dtype=jnp.float32,
 ) -> tuple[jax.Array, jax.Array]:
     """Compute flex attention densely.
@@ -45,7 +47,11 @@ def sdpa_attn(
     if softmax_scale is None:
         softmax_scale = d ** -0.5
 
-    mask = build_dense_mask(q_ranges, k_ranges, attn_type_map, sq, sk)
+    if d_lo is None or d_hi is None:
+        if attn_type_map is None:
+            attn_type_map = jnp.zeros((q_ranges.shape[0],), dtype=jnp.int32)
+        d_lo, d_hi = types_to_bands(q_ranges, k_ranges, attn_type_map)
+    mask = build_dense_mask_band(q_ranges, k_ranges, d_lo, d_hi, sq, sk)
 
     qc = q.astype(compute_dtype)
     kc = jnp.repeat(k.astype(compute_dtype), g, axis=1)  # [sk, hq, d]

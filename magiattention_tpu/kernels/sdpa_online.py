@@ -10,7 +10,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .mask_utils import build_dense_mask
+from .mask_utils import build_dense_mask_band, types_to_bands
 
 NEG_INF = float("-inf")
 
@@ -21,9 +21,11 @@ def sdpa_online_attn(
     v: jax.Array,
     q_ranges: jax.Array,
     k_ranges: jax.Array,
-    attn_type_map: jax.Array,
+    attn_type_map: jax.Array | None = None,
     softmax_scale: float | None = None,
     softcap: float = 0.0,
+    d_lo: jax.Array | None = None,
+    d_hi: jax.Array | None = None,
     block_k: int = 512,
     compute_dtype=jnp.float32,
 ) -> tuple[jax.Array, jax.Array]:
@@ -33,6 +35,10 @@ def sdpa_online_attn(
     g = hq // hk
     if softmax_scale is None:
         softmax_scale = d ** -0.5
+    if d_lo is None or d_hi is None:
+        if attn_type_map is None:
+            attn_type_map = jnp.zeros((q_ranges.shape[0],), dtype=jnp.int32)
+        d_lo, d_hi = types_to_bands(q_ranges, k_ranges, attn_type_map)
 
     num_blocks = -(-sk // block_k)
     sk_pad = num_blocks * block_k
@@ -52,8 +58,8 @@ def sdpa_online_attn(
         if softcap > 0.0:
             logits = softcap * jnp.tanh(logits / softcap)
         k_off = blk_idx * block_k
-        mask = build_dense_mask(
-            q_ranges, k_ranges, attn_type_map, sq, block_k, k_offset=k_off
+        mask = build_dense_mask_band(
+            q_ranges, k_ranges, d_lo, d_hi, sq, block_k, k_offset=k_off
         )
         # padding cols beyond sk are masked automatically (k >= every k_range end)
         logits = jnp.where(mask[None], logits, NEG_INF)
