@@ -7,12 +7,15 @@ schedulers, mask.h). Design differences, deliberate and TPU-first:
 - The device-side persistent tile scheduler is replaced by a host-side plan
   (:mod:`ffa_plan`) + ``PrefetchScalarGridSpec``: the grid is exactly the list
   of non-empty (q_tile, k_tile, slice) work items, so fully-masked tiles cost
-  nothing and no dynamic control flow reaches the MXU.
+  nothing and no dynamic control flow reaches the MXU. Plan *contents* may be
+  traced arrays (per-CP-rank metadata under shard_map); only the work counts
+  and tile geometry are static.
 - The atomic-reduce epilogues (epilogue_fwd.hpp / epilogue_bwd.hpp) are
   replaced by run-ordering: all work items of one output tile are consecutive
   grid steps accumulating into VMEM scratch; the tile is written once at the
   end of its run. dq uses the q-major plan, dk/dv the k-major plan — no
   atomics exist on TPU and none are needed.
+- Slices are diagonal bands (d_lo <= j - i <= d_hi): the mask is two compares.
 - Online-softmax merge math matches functional/utils.py (lse in natural log,
   -inf on fully-masked rows).
 
@@ -33,7 +36,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..env import general as env_general
 from ..env import kernel as env_kernel
-from .ffa_plan import (  # noqa: F401
+from .ffa_plan import (
     DHI,
     DLO,
     IS_FIRST,
@@ -58,11 +61,28 @@ def _round_up(x: int, m: int) -> int:
 class FFAParams:
     """Static kernel parameters (hashable by identity for custom_vjp)."""
 
-    plan: FFAPlan
+    num_work: int
+    num_work_t: int
+    num_q_tiles: int
+    num_k_tiles: int
+    block_q: int
+    block_k: int
     softmax_scale: float
     softcap: float
     group: int  # hq // hk
     interpret: bool
+
+
+def plan_arrays(plan: FFAPlan) -> tuple[jax.Array, ...]:
+    """The 6 device arrays of a plan (q-major triple + k-major triple)."""
+    return (
+        jnp.asarray(plan.work_qt),
+        jnp.asarray(plan.work_kt),
+        jnp.asarray(plan.meta),
+        jnp.asarray(plan.work_qt_t),
+        jnp.asarray(plan.work_kt_t),
+        jnp.asarray(plan.meta_t),
+    )
 
 
 def _item_mask(
@@ -164,14 +184,13 @@ def _fwd_kernel(
         lse_ref[...] = lse.astype(jnp.float32)[:, None]
 
 
-def _ffa_fwd_pallas(params: FFAParams, q_t, k_t, v_t):
+def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
     """q_t/k_t/v_t are head-major padded: [hq,sqp,d], [hk,skp,d], [hk,skp,dv]."""
-    plan = params.plan
-    bq, bk = plan.block_q, plan.block_k
+    bq, bk = params.block_q, params.block_k
     hq, sqp, d = q_t.shape
     hk, skp, dv = v_t.shape
     g = params.group
-    W = plan.num_work
+    W = params.num_work
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -227,14 +246,7 @@ def _ffa_fwd_pallas(params: FFAParams, q_t, k_t, v_t):
             bytes_accessed=(q_t.size + k_t.size + v_t.size) * q_t.dtype.itemsize,
             transcendentals=W * bq * bk * hq,
         ),
-    )(
-        jnp.asarray(plan.work_qt),
-        jnp.asarray(plan.work_kt),
-        jnp.asarray(plan.meta),
-        q_t,
-        k_t,
-        v_t,
-    )
+    )(work_qt, work_kt, meta, q_t, k_t, v_t)
     return out_t, lse_t[..., 0]
 
 
@@ -308,13 +320,14 @@ def _bwd_dq_kernel(
         dq_ref[0] = dq_scr[:]
 
 
-def _ffa_bwd_dq_pallas(params: FFAParams, q_t, k_t, v_t, do_t, lse_t, delta_t):
-    plan = params.plan
-    bq, bk = plan.block_q, plan.block_k
+def _ffa_bwd_dq_pallas(
+    params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t, delta_t
+):
+    bq, bk = params.block_q, params.block_k
     hq, sqp, d = q_t.shape
     _, _, dv = v_t.shape
     g = params.group
-    W = plan.num_work
+    W = params.num_work
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -348,12 +361,8 @@ def _ffa_bwd_dq_pallas(params: FFAParams, q_t, k_t, v_t, do_t, lse_t, delta_t):
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((hq, sqp, d), jnp.float32)],
         interpret=params.interpret,
-    )(
-        jnp.asarray(plan.work_qt),
-        jnp.asarray(plan.work_kt),
-        jnp.asarray(plan.meta),
-        q_t, k_t, v_t, do_t, lse_t[..., None], delta_t[..., None],
-    )
+    )(work_qt, work_kt, meta, q_t, k_t, v_t, do_t,
+      lse_t[..., None], delta_t[..., None])
     return dq_t
 
 
@@ -437,13 +446,15 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_scr[:]
 
 
-def _ffa_bwd_dkv_pallas(params: FFAParams, q_t, k_t, v_t, do_t, lse_t, delta_t):
-    plan = params.plan
-    bq, bk = plan.block_q, plan.block_k
+def _ffa_bwd_dkv_pallas(
+    params: FFAParams, work_qt_t, work_kt_t, meta_t,
+    q_t, k_t, v_t, do_t, lse_t, delta_t,
+):
+    bq, bk = params.block_q, params.block_k
     hq, sqp, d = q_t.shape
     hk, skp, dv = v_t.shape
     g = params.group
-    WT = plan.num_work_t
+    WT = params.num_work_t
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -485,12 +496,8 @@ def _ffa_bwd_dkv_pallas(params: FFAParams, q_t, k_t, v_t, do_t, lse_t, delta_t):
             jax.ShapeDtypeStruct((hq, skp, dv), jnp.float32),
         ],
         interpret=params.interpret,
-    )(
-        jnp.asarray(plan.work_qt_t),
-        jnp.asarray(plan.work_kt_t),
-        jnp.asarray(plan.meta_t),
-        q_t, k_t, v_t, do_t, lse_t[..., None], delta_t[..., None],
-    )
+    )(work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
+      lse_t[..., None], delta_t[..., None])
     return dk_t, dv_t
 
 
@@ -499,32 +506,51 @@ def _ffa_bwd_dkv_pallas(params: FFAParams, q_t, k_t, v_t, do_t, lse_t, delta_t):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _ffa_core(q_t, k_t, v_t, params: FFAParams):
-    return _ffa_fwd_pallas(params, q_t, k_t, v_t)
+@partial(jax.custom_vjp, nondiff_argnums=(9,))
+def _ffa_core(
+    q_t, k_t, v_t, work_qt, work_kt, meta, work_qt_t, work_kt_t, meta_t,
+    params: FFAParams,
+):
+    return _ffa_fwd_pallas(params, work_qt, work_kt, meta, q_t, k_t, v_t)
 
 
-def _ffa_core_fwd(q_t, k_t, v_t, params: FFAParams):
-    out_t, lse_t = _ffa_fwd_pallas(params, q_t, k_t, v_t)
-    return (out_t, lse_t), (q_t, k_t, v_t, out_t, lse_t)
+def _ffa_core_fwd(
+    q_t, k_t, v_t, work_qt, work_kt, meta, work_qt_t, work_kt_t, meta_t,
+    params: FFAParams,
+):
+    out_t, lse_t = _ffa_fwd_pallas(params, work_qt, work_kt, meta, q_t, k_t, v_t)
+    res = (q_t, k_t, v_t, out_t, lse_t, work_qt, work_kt, meta,
+           work_qt_t, work_kt_t, meta_t)
+    return (out_t, lse_t), res
 
 
 def _ffa_core_bwd(params: FFAParams, res, cts):
     # lse is an auxiliary output: its cotangent is ignored (the CP runtime
     # differentiates the lse-merge manually, matching the reference).
     do_t, _ = cts
-    q_t, k_t, v_t, out_t, lse_t = res
+    (q_t, k_t, v_t, out_t, lse_t, work_qt, work_kt, meta,
+     work_qt_t, work_kt_t, meta_t) = res
     delta_t = jnp.sum(
         do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
     )  # (hq, sqp)
-    dq_t = _ffa_bwd_dq_pallas(params, q_t, k_t, v_t, do_t, lse_t, delta_t)
-    dk_t, dv_t = _ffa_bwd_dkv_pallas(params, q_t, k_t, v_t, do_t, lse_t, delta_t)
+    dq_t = _ffa_bwd_dq_pallas(
+        params, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t, delta_t
+    )
+    dk_t, dv_t = _ffa_bwd_dkv_pallas(
+        params, work_qt_t, work_kt_t, meta_t,
+        q_t, k_t, v_t, do_t, lse_t, delta_t,
+    )
     g = params.group
     if g > 1:
         hq, skp, d = dk_t.shape
         dk_t = dk_t.reshape(hq // g, g, skp, d).sum(axis=1)
         dv_t = dv_t.reshape(hq // g, g, skp, dv_t.shape[-1]).sum(axis=1)
-    return dq_t.astype(q_t.dtype), dk_t.astype(k_t.dtype), dv_t.astype(v_t.dtype)
+    return (
+        dq_t.astype(q_t.dtype),
+        dk_t.astype(k_t.dtype),
+        dv_t.astype(v_t.dtype),
+        None, None, None, None, None, None,
+    )
 
 
 _ffa_core.defvjp(_ffa_core_fwd, _ffa_core_bwd)
@@ -535,6 +561,42 @@ def _should_interpret() -> bool:
         env_general.is_interpret_mode_enable()
         or jax.default_backend() == "cpu"
     )
+
+
+def ffa_attn_with_plan(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    arrays: tuple[jax.Array, ...],
+    params: FFAParams,
+) -> tuple[jax.Array, jax.Array]:
+    """FFA over an explicit plan — the CP-runtime entry point.
+
+    Args:
+        q/k/v: ``[sq,hq,d] / [sk,hk,d] / [sk,hk,dv]``, seq-major.
+        arrays: the 6 plan arrays (:func:`plan_arrays`), possibly traced
+            (per-rank metadata under shard_map), padded to params.num_work /
+            params.num_work_t.
+        params: static dims + scalars; sq/sk must fit the tile counts.
+
+    Returns:
+        (out ``[sq,hq,dv]``, lse ``[sq,hq]`` fp32).
+    """
+    sq, hq, d = q.shape
+    sk, hk, dv = v.shape
+    sqp = params.num_q_tiles * params.block_q
+    skp = params.num_k_tiles * params.block_k
+    q_t = jnp.pad(q, ((0, sqp - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
+    k_t = jnp.pad(k, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
+    v_t = jnp.pad(v, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
+    out_t, lse_t = _ffa_core(q_t, k_t, v_t, *arrays, params)
+    return out_t.transpose(1, 0, 2)[:sq], lse_t.T[:sq]
+
+
+def default_blocks(sq: int, sk: int, block_q=None, block_k=None) -> tuple[int, int]:
+    bq = block_q or env_kernel.ffa_block_q()
+    bk = block_k or env_kernel.ffa_block_k()
+    return min(bq, _round_up(sq, 16)), min(bk, _round_up(sk, 128))
 
 
 def ffa_attn(
@@ -557,7 +619,7 @@ def ffa_attn(
     diagonal bands (``d_lo``/``d_hi``). The metadata must be *concrete*
     (host) values — it parameterizes the kernel grid. Inside jit-traced code,
     close over it (the runtime manager caches traced plans per mask,
-    mirroring the reference's runtime LRU).
+    mirroring the reference's runtime LRU), or use :func:`ffa_attn_with_plan`.
     """
     try:
         qr = np.asarray(q_ranges, dtype=np.int32)
@@ -575,36 +637,26 @@ def ffa_attn(
     except Exception as e:  # pragma: no cover
         raise ValueError(
             "ffa_attn requires concrete (host) slice metadata; inside jit, "
-            "close over the metadata or use the sdpa backends"
+            "close over the metadata or use ffa_attn_with_plan"
         ) from e
 
     sq, hq, d = q.shape
     sk, hk, dv = v.shape
-    g = hq // hk
     if softmax_scale is None:
         softmax_scale = float(d) ** -0.5
-
-    bq = block_q or env_kernel.ffa_block_q()
-    bk = block_k or env_kernel.ffa_block_k()
-    bq = min(bq, _round_up(sq, 16))
-    bk = min(bk, _round_up(sk, 128))
+    bq, bk = default_blocks(sq, sk, block_q, block_k)
 
     plan = get_ffa_plan(qr, kr, d_lo, d_hi, sq, sk, bq, bk)
     params = FFAParams(
-        plan=plan,
+        num_work=plan.num_work,
+        num_work_t=plan.num_work_t,
+        num_q_tiles=plan.num_q_tiles,
+        num_k_tiles=plan.num_k_tiles,
+        block_q=bq,
+        block_k=bk,
         softmax_scale=float(softmax_scale),
         softcap=float(softcap),
-        group=g,
+        group=hq // hk,
         interpret=_should_interpret(),
     )
-
-    sqp = plan.num_q_tiles * bq
-    skp = plan.num_k_tiles * bk
-    q_t = jnp.pad(q, ((0, sqp - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
-    k_t = jnp.pad(k, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
-    v_t = jnp.pad(v, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
-
-    out_t, lse_t = _ffa_core(q_t, k_t, v_t, params)
-    out = out_t.transpose(1, 0, 2)[:sq]
-    lse = lse_t.T[:sq]
-    return out, lse
+    return ffa_attn_with_plan(q, k, v, plan_arrays(plan), params)

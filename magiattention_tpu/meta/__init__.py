@@ -1,0 +1,12 @@
+"""Meta/solver layer: host-side planning from slice metadata to device args.
+
+Pipeline (ref: SURVEY §3.1):
+  make_dispatch_meta_from_qk_ranges  -> DispatchMeta (chunk->rank assignment)
+  make_attn_meta_from_dispatch_meta  -> CommMeta + CalcMeta (per-rank plans)
+"""
+
+from ._make_dispatch_meta import make_dispatch_meta_from_qk_ranges  # noqa: F401
+from ._make_attn_meta import make_attn_meta_from_dispatch_meta  # noqa: F401
+from .collection.dispatch_meta import DispatchMeta  # noqa: F401
+from .collection.calc_meta import AttnArg, CalcMeta  # noqa: F401
+from .collection.comm_meta import CommMeta, GroupCollectiveArg  # noqa: F401

@@ -1,0 +1,29 @@
+"""Attn meta construction (ref: magi_attention/meta/_make_attn_meta.py:40-133).
+
+Picks the CP planner (static DistAttnSolver; the dynamic qo-comm solver plugs
+in here later), runs solve(), returns (CommMeta, CalcMeta).
+"""
+
+from __future__ import annotations
+
+from ..config import DistAttnConfig
+from .collection.calc_meta import CalcMeta
+from .collection.comm_meta import CommMeta
+from .collection.dispatch_meta import DispatchMeta
+from .container.bucket import AttnBucket
+from .solver.dist_attn_solver import DistAttnSolver
+
+
+def make_attn_meta_from_dispatch_meta(
+    bucket: AttnBucket,
+    dispatch_meta: DispatchMeta,
+    config: DistAttnConfig | None = None,
+) -> tuple[CommMeta, CalcMeta]:
+    config = config or DistAttnConfig()
+    solver = DistAttnSolver(
+        bucket=bucket,
+        dispatch_meta=dispatch_meta,
+        overlap_config=config.overlap_config,
+        split_alignment=config.grpcoll_config.split_alignment,
+    )
+    return solver.solve()

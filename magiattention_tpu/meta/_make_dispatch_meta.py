@@ -1,0 +1,104 @@
+"""Dispatch meta construction (ref: magi_attention/meta/_make_dispatch_meta.py:56-405).
+
+Chunks the global sequence, computes per-chunk attention areas from the slice
+metadata (the global AttnBucket), runs the DispatchSolver, and emits the
+DispatchMeta with permutation indices.
+"""
+
+from __future__ import annotations
+
+from ..common.enum import AttnMaskType, AttnType
+from ..common.range import AttnRange
+from ..common.ranges import AttnRanges
+from .collection.dispatch_meta import DispatchMeta
+from .container.bucket import AttnBucket, AttnChunk
+from .container.slice import AttnSlice
+from .solver.dispatch_solver import DispatchConfig, DispatchSolver
+
+
+def make_global_bucket_from_qk_ranges(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_mask_type: list[AttnMaskType],
+    total_seqlen_q: int,
+    chunk_size: int,
+) -> AttnBucket:
+    """Per-chunk slice lists + areas (ref: _make_dispatch_meta.py:450).
+
+    Each global slice is clipped to every chunk it intersects; band encoding
+    keeps the clip exact (no type re-derivation).
+    """
+    num_chunks = -(-total_seqlen_q // chunk_size)
+    chunks = [
+        AttnChunk(
+            chunk_id=c,
+            q_range=AttnRange(
+                c * chunk_size, min((c + 1) * chunk_size, total_seqlen_q)
+            ),
+        )
+        for c in range(num_chunks)
+    ]
+    slices = [
+        AttnSlice.from_mask_type(qr, kr, AttnMaskType.normalize(mt))
+        for qr, kr, mt in zip(q_ranges, k_ranges, attn_mask_type)
+    ]
+    for s in slices:
+        if s.q_range.is_empty():
+            continue
+        c_lo = s.q_range.start // chunk_size
+        c_hi = -(-s.q_range.end // chunk_size)
+        for c in range(c_lo, min(c_hi, num_chunks)):
+            clipped = s.clip_q(chunks[c].q_range.start, chunks[c].q_range.end)
+            if not clipped.q_range.is_empty() and clipped.area > 0:
+                chunks[c].attn_slices.append(clipped)
+    return AttnBucket(cp_rank=None, q_chunks=chunks)
+
+
+def make_dispatch_meta_from_qk_ranges(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_mask_type: list[AttnMaskType],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    chunk_size: int,
+    cp_size: int,
+    dispatch_config: DispatchConfig | None = None,
+) -> tuple[DispatchMeta, DispatchMeta, AttnBucket]:
+    """Build (q_meta, kv_meta, global_bucket) for self-attention.
+
+    For self-attention q and kv share the same chunk assignment (the reference
+    dispatches q/o and k/v with the same DispatchMeta for SELF_ATTN).
+    """
+    if total_seqlen_q % chunk_size != 0:
+        raise ValueError(
+            f"total_seqlen_q {total_seqlen_q} not divisible by chunk_size "
+            f"{chunk_size}; pad first (api.compute_pad_size)"
+        )
+    num_chunks = total_seqlen_q // chunk_size
+    if num_chunks % cp_size != 0:
+        raise ValueError(
+            f"num_chunks {num_chunks} not divisible by cp_size {cp_size}"
+        )
+
+    dispatch_config = dispatch_config or DispatchConfig()
+    bucket = make_global_bucket_from_qk_ranges(
+        q_ranges, k_ranges, attn_mask_type, total_seqlen_q, chunk_size
+    )
+    areas = bucket.areas_per_chunk
+
+    if cp_size == 1:
+        partitions = [list(range(num_chunks))]
+    else:
+        solver = DispatchSolver(alg=dispatch_config.alg, config=dispatch_config)
+        partitions = solver.solve(areas, cp_size).partitions
+
+    meta_q = DispatchMeta(
+        attn_type=AttnType.SELF_ATTN,
+        total_seqlen=total_seqlen_q,
+        chunk_size=chunk_size,
+        cp_size=cp_size,
+        partitions=partitions,
+    )
+    # self-attn: kv follows q's assignment
+    meta_kv = meta_q
+    return meta_q, meta_kv, bucket

@@ -1,0 +1,1 @@
+"""Planning outputs: DispatchMeta, CalcMeta, CommMeta."""

@@ -1,0 +1,61 @@
+"""CommMeta / GroupCollectiveArg — the group-collective plan.
+
+Ref: magi_attention/meta/collection/comm_meta.py:41-765. A
+GroupCollectiveArg describes one GroupCast stage as whole-mesh index arrays
+that lower onto ``jax.lax.all_to_all`` inside shard_map:
+
+  send:  every rank gathers ``send_idx[rank]`` rows of its kv shard into a
+         (cp, A) buffer (A = aligned max rows per (src,dst) pair)
+  a2a:   all_to_all over the cp axis
+  recv:  every rank gathers ``recv_sel[rank]`` rows of the flattened (cp*A)
+         receive buffer into its remote-kv buffer (R_max rows)
+
+The transpose of this program under jax AD is exactly GroupReduce (scatter-add
+back through the gathers + reverse all_to_all), so the backward dkv reduction
+needs no hand-written comm (XLA replaces the reference's
+group_reduce/_reduce_partial_dkv machinery, dist_attn.py:2123).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ...common.ranges import AttnRanges
+
+
+@dataclass
+class GroupCollectiveArg:
+    """One GroupCast stage over the whole mesh."""
+
+    # [dst][src] -> global k ranges src sends to dst (the transfer table,
+    # ref meta/container/transfer_table.py)
+    transfer_table: list[list[AttnRanges]]
+    # lowering arrays
+    send_idx: np.ndarray  # (cp, cp, A) int32 — [src][dst] local row indices
+    send_counts: np.ndarray  # (cp, cp) int32
+    recv_sel: np.ndarray  # (cp, R_max) int32 — [dst] flat src*A+pos selects
+    recv_len: np.ndarray  # (cp,) int32 — valid rows per dst
+    a_cap: int  # per-pair aligned capacity A
+    r_max: int  # padded receive length
+
+    def total_send_rows(self) -> int:
+        return int(self.send_counts.sum())
+
+    def comm_volume_bytes(self, row_bytes: int) -> int:
+        """Payload actually needed (excludes alignment padding)."""
+        off_diag = self.send_counts.copy()
+        np.fill_diagonal(off_diag, 0)
+        return int(off_diag.sum()) * row_bytes
+
+
+@dataclass
+class CommMeta:
+    """All GroupCast stages of the forward pass (kv; qo-comm adds more)."""
+
+    kv_stages: list[GroupCollectiveArg] = field(default_factory=list)
+
+    @property
+    def overlap_degree(self) -> int:
+        return len(self.kv_stages)

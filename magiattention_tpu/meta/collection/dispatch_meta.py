@@ -1,0 +1,88 @@
+"""DispatchMeta — the chunk->rank assignment and its permutations.
+
+Ref: magi_attention/meta/collection/dispatch_meta.py:24-122. For the TPU
+build the permutation lives as host numpy index arrays that become static
+gather indices inside the sharded dispatch/undispatch ops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ...common.enum import AttnType
+from ...common.ranges import AttnRanges
+
+
+@dataclass
+class DispatchMeta:
+    """Assignment of sequence chunks to CP ranks.
+
+    Attributes:
+        attn_type: self or cross attention.
+        total_seqlen: global (padded) sequence length.
+        chunk_size: rows per chunk.
+        cp_size: number of CP ranks.
+        partitions: chunk ids per rank, sorted ascending within each rank.
+        position_ids: ``(cp_size, shard_len)`` int32 — global row index of
+            each local row, per rank (the dispatch gather indices).
+        host_ranges_per_rank: merged global row ranges owned by each rank.
+    """
+
+    attn_type: AttnType
+    total_seqlen: int
+    chunk_size: int
+    cp_size: int
+    partitions: list[list[int]]
+    _position_ids: np.ndarray | None = field(default=None, repr=False)
+    _host_ranges: list[AttnRanges] | None = field(default=None, repr=False)
+
+    @property
+    def num_chunks(self) -> int:
+        return self.total_seqlen // self.chunk_size
+
+    @property
+    def shard_seqlen(self) -> int:
+        return self.total_seqlen // self.cp_size
+
+    @property
+    def position_ids(self) -> np.ndarray:
+        if self._position_ids is None:
+            cs = self.chunk_size
+            out = np.empty((self.cp_size, self.shard_seqlen), dtype=np.int32)
+            for r, chunks in enumerate(self.partitions):
+                rows = [np.arange(c * cs, (c + 1) * cs, dtype=np.int32) for c in chunks]
+                out[r] = np.concatenate(rows)
+            self._position_ids = out
+        return self._position_ids
+
+    @property
+    def host_ranges_per_rank(self) -> list[AttnRanges]:
+        if self._host_ranges is None:
+            cs = self.chunk_size
+            self._host_ranges = [
+                AttnRanges.from_ranges(
+                    [(c * cs, (c + 1) * cs) for c in chunks]
+                ).merge()
+                for chunks in self.partitions
+            ]
+        return self._host_ranges
+
+    @property
+    def unpermute_index(self) -> np.ndarray:
+        """``(total_seqlen,)`` int32: for each global row, its index in the
+        rank-major concatenation of all local shards (the undispatch gather)."""
+        flat = self.position_ids.reshape(-1)
+        inv = np.empty_like(flat)
+        inv[flat] = np.arange(len(flat), dtype=np.int32)
+        return inv
+
+    def global_row_owner(self) -> np.ndarray:
+        """``(total_seqlen,)`` int32 rank owning each global row."""
+        owner = np.empty(self.total_seqlen, dtype=np.int32)
+        cs = self.chunk_size
+        for r, chunks in enumerate(self.partitions):
+            for c in chunks:
+                owner[c * cs : (c + 1) * cs] = r
+        return owner

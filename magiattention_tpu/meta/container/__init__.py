@@ -1,0 +1,4 @@
+"""Planning containers: slices, chunks, buckets (ref: magi_attention/meta/container/)."""
+
+from .slice import AttnSlice, band_area  # noqa: F401
+from .bucket import AttnBucket, AttnChunk  # noqa: F401

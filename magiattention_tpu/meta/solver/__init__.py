@@ -1,0 +1,1 @@
+"""Planning solvers: dispatch load balancing, CP comm/calc planning, overlap."""

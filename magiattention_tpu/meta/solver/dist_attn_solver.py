@@ -1,0 +1,363 @@
+"""The static CP planner (ref: magi_attention/meta/solver/dist_attn_solver.py:206).
+
+Per rank, from the dispatched chunk assignment and the global slice metadata:
+
+1. split every owned slice's needed k coverage into host (locally owned) vs
+   remote rows (ref :440);
+2. deduplicate remote requests per source rank — each remote row is fetched
+   exactly once even if many slices touch it (the zero-redundant property);
+3. lay out the remote-kv receive buffer (src-rank-major, ascending global
+   ranges) and assign overlap stages by balanced row count (ref :944);
+4. emit the transfer table + lowering index arrays (CommMeta, ref :1669) and
+   the host/remote/merged band-slice lists in local coordinates (CalcMeta,
+   ref :1839).
+
+Band encoding makes every clip exact, so no slice-maker type re-derivation
+(slice_maker.py) is needed: local bands are global bands shifted by the
+(q, k) local-coordinate offsets.
+
+All of this is deterministic host code computed identically on every rank
+(no communication), exactly like the reference's transfer-table construction
+(ref :1368 — "every rank computes all ranks' entries").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ...common.range import AttnRange
+from ...common.ranges import AttnRanges
+from ...config import OverlapConfig
+from ...kernels.mask_utils import BAND_INF
+from ..collection.calc_meta import AttnArg, CalcMeta
+from ..collection.comm_meta import CommMeta, GroupCollectiveArg
+from ..collection.dispatch_meta import DispatchMeta
+from ..container.bucket import AttnBucket
+from ..container.slice import AttnSlice
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@dataclass
+class _RemoteInterval:
+    """One merged remote k interval in a rank's receive buffer."""
+
+    src: int
+    grange: AttnRange  # global coords
+    stage: int = 0
+    offset: int = 0  # local offset within its stage's receive buffer
+
+
+class DistAttnSolver:
+    """Static (kv-comm) context-parallel planner."""
+
+    def __init__(
+        self,
+        bucket: AttnBucket,
+        dispatch_meta: DispatchMeta,
+        overlap_config: OverlapConfig | None = None,
+        split_alignment: int = 128,
+    ) -> None:
+        self.bucket = bucket
+        self.meta = dispatch_meta
+        self.cp_size = dispatch_meta.cp_size
+        self.overlap_config = overlap_config or OverlapConfig()
+        self.split_alignment = split_alignment
+
+    # ------------------------------------------------------------------
+
+    def solve(self) -> tuple[CommMeta, CalcMeta]:
+        cp = self.cp_size
+        meta = self.meta
+        shard_len = meta.shard_seqlen
+        host_ranges = meta.host_ranges_per_rank
+        degree = max(1, self.overlap_config.degree or 1)
+        if not self.overlap_config.enable:
+            degree = 1
+
+        chunks_by_id = {c.chunk_id: c for c in self.bucket.q_chunks}
+
+        # ---- pass 1: per rank, split slice coverage into host/remote -----
+        # host slice tuples per rank: (qs,qe,ks,ke,lo,hi) local coords
+        host_slices: list[list[tuple[int, ...]]] = [[] for _ in range(cp)]
+        # deferred remote pieces per rank: (q_loc_range, k_global_range, lo,
+        # hi, qoff) — k local offset resolved after buffer layout
+        deferred: list[list[tuple[AttnRange, AttnRange, int, int, int]]] = [
+            [] for _ in range(cp)
+        ]
+        # remote requests per rank per src: global ranges
+        requests: list[list[AttnRanges]] = [
+            [AttnRanges() for _ in range(cp)] for _ in range(cp)
+        ]
+
+        for r in range(cp):
+            own = host_ranges[r]
+            for chunk_id in meta.partitions[r]:
+                chunk = chunks_by_id[chunk_id]
+                for s in chunk.attn_slices:
+                    self._split_slice(
+                        s, r, own, host_ranges,
+                        host_slices[r], deferred[r], requests[r],
+                    )
+
+        # ---- pass 2: merge requests, stage them, lay out buffers ---------
+        intervals: list[list[_RemoteInterval]] = [[] for _ in range(cp)]
+        for r in range(cp):
+            for src in range(cp):
+                for g in requests[r][src].merge():
+                    intervals[r].append(_RemoteInterval(src=src, grange=g))
+
+        self._assign_stages(intervals, degree)
+
+        rank_stage_len: list[list[int]] = [[0] * degree for _ in range(cp)]
+        for r in range(cp):
+            for st in range(degree):
+                off = 0
+                for iv in intervals[r]:
+                    if iv.stage != st:
+                        continue
+                    iv.offset = off
+                    off += iv.grange.seqlen
+                rank_stage_len[r][st] = off
+
+        # drop stages empty on every rank (e.g. cp=1: no remote kv at all),
+        # then pad each kept stage's receive length to the alignment
+        kept = [
+            st for st in range(degree)
+            if max(rank_stage_len[r][st] for r in range(cp)) > 0
+        ]
+        remap = {st: i for i, st in enumerate(kept)}
+        for r in range(cp):
+            for iv in intervals[r]:
+                iv.stage = remap[iv.stage]
+        stage_recv_len = [
+            _round_up(
+                max(rank_stage_len[r][st] for r in range(cp)),
+                self.split_alignment,
+            )
+            for st in kept
+        ]
+        degree = len(kept)
+
+        # ---- pass 3: emit remote slices in buffer-local coords -----------
+        remote_slices: list[list[list[tuple[int, ...]]]] = [
+            [[] for _ in range(cp)] for _ in range(degree)
+        ]
+        merged_slices: list[list[tuple[int, ...]]] = [list(hs) for hs in host_slices]
+        # merged buffer: [shard | stage0 | stage1 | ...]
+        stage_base = [shard_len]
+        for st in range(1, degree):
+            stage_base.append(stage_base[-1] + stage_recv_len[st - 1])
+
+        for r in range(cp):
+            ivs = intervals[r]
+            for q_loc, k_glob, lo, hi, qoff in deferred[r]:
+                iv = _find_interval(ivs, k_glob)
+                k_loc_start = iv.offset + (k_glob.start - iv.grange.start)
+                k_loc = (k_loc_start, k_loc_start + k_glob.seqlen)
+                koff = k_glob.start - k_loc_start
+                lo_l = lo if lo <= -BAND_INF else lo + qoff - koff
+                hi_l = hi if hi >= BAND_INF else hi + qoff - koff
+                remote_slices[iv.stage][r].append(
+                    (q_loc.start, q_loc.end, k_loc[0], k_loc[1], lo_l, hi_l)
+                )
+                mb = stage_base[iv.stage]
+                koff_m = k_glob.start - (k_loc_start + mb)
+                lo_m = lo if lo <= -BAND_INF else lo + qoff - koff_m
+                hi_m = hi if hi >= BAND_INF else hi + qoff - koff_m
+                merged_slices[r].append(
+                    (q_loc.start, q_loc.end, k_loc[0] + mb, k_loc[1] + mb,
+                     lo_m, hi_m)
+                )
+
+        # ---- pass 4: comm args per stage ---------------------------------
+        kv_stages = []
+        for st in range(degree):
+            kv_stages.append(
+                self._make_group_collective_arg(
+                    intervals, host_ranges, st, stage_recv_len[st]
+                )
+            )
+
+        total_recv = sum(stage_recv_len)
+        calc_meta = CalcMeta(
+            host_args=[
+                AttnArg.from_slices(host_slices[r], shard_len, shard_len)
+                for r in range(cp)
+            ],
+            remote_args_per_stage=[
+                [
+                    AttnArg.from_slices(
+                        remote_slices[st][r], shard_len, stage_recv_len[st]
+                    )
+                    for r in range(cp)
+                ]
+                for st in range(degree)
+            ],
+            merged_args=[
+                AttnArg.from_slices(
+                    merged_slices[r], shard_len, shard_len + total_recv
+                )
+                for r in range(cp)
+            ],
+            shard_len=shard_len,
+            recv_len_per_stage=stage_recv_len,
+        )
+        return CommMeta(kv_stages=kv_stages), calc_meta
+
+    # ------------------------------------------------------------------
+
+    def _split_slice(
+        self,
+        s: AttnSlice,
+        rank: int,
+        own: AttnRanges,
+        host_ranges: list[AttnRanges],
+        host_out: list[tuple[int, ...]],
+        deferred_out: list[tuple[AttnRange, AttnRange, int, int, int]],
+        requests_out: list[AttnRanges],
+    ) -> None:
+        """Split one owned (chunk-clipped) slice into host/remote pieces."""
+        shrunk = s.shrink()
+        if shrunk.q_range.is_empty():
+            return
+        q_glob = shrunk.q_range
+        q_loc = own.make_range_local(q_glob)
+        qoff = q_glob.start - q_loc.start
+        needed_k = shrunk.needed_k_range()
+        if needed_k.is_empty():
+            return
+        needed = AttnRanges([needed_k])
+        lo, hi = shrunk.d_lo, shrunk.d_hi
+
+        # local parts
+        for part in needed.find_overlap_ranges(own):
+            for k_loc in own.make_ranges_local(AttnRanges([part])):
+                # recover the global start of this contiguous local piece
+                k_glob_start = _local_to_global(own, k_loc.start)
+                koff = k_glob_start - k_loc.start
+                lo_l = lo if lo <= -BAND_INF else lo + qoff - koff
+                hi_l = hi if hi >= BAND_INF else hi + qoff - koff
+                host_out.append(
+                    (q_loc.start, q_loc.end, k_loc.start, k_loc.end, lo_l, hi_l)
+                )
+
+        # remote parts, split by owner
+        for hole in needed.find_hole_ranges(own):
+            for src in range(self.cp_size):
+                if src == rank:
+                    continue
+                for part in AttnRanges([hole]).find_overlap_ranges(
+                    host_ranges[src]
+                ):
+                    requests_out[src].append(part)
+                    deferred_out.append((q_loc, part, lo, hi, qoff))
+
+    @staticmethod
+    def _assign_stages(
+        intervals: list[list[_RemoteInterval]], degree: int
+    ) -> None:
+        """Greedy balanced grouping of each rank's intervals into stages
+        (ref solver/overlap_solver.py UniformOverlapAlg)."""
+        if degree == 1:
+            return
+        for ivs in intervals:
+            total = sum(iv.grange.seqlen for iv in ivs)
+            target = -(-total // degree) if total else 1
+            st, acc = 0, 0
+            for iv in ivs:
+                iv.stage = min(st, degree - 1)
+                acc += iv.grange.seqlen
+                if acc >= target * (st + 1) and st < degree - 1:
+                    st += 1
+
+    def _make_group_collective_arg(
+        self,
+        intervals: list[list[_RemoteInterval]],
+        host_ranges: list[AttnRanges],
+        stage: int,
+        recv_len_padded: int,
+    ) -> GroupCollectiveArg:
+        cp = self.cp_size
+        transfer_table = [[AttnRanges() for _ in range(cp)] for _ in range(cp)]
+        send_rows: list[list[list[int]]] = [
+            [[] for _ in range(cp)] for _ in range(cp)
+        ]  # [src][dst]
+        recv_parts: list[list[tuple[int, int, int]]] = [
+            [] for _ in range(cp)
+        ]  # [dst] -> (src, pos_in_pair, buffer_offset) implicit by order
+
+        for dst in range(cp):
+            # buffer order: interval order (src asc, grange asc) — matches
+            # offsets assigned in solve()
+            for iv in sorted(
+                (iv for iv in intervals[dst] if iv.stage == stage),
+                key=lambda iv: iv.offset,
+            ):
+                transfer_table[dst][iv.src].append(iv.grange)
+                local_rows = host_ranges[iv.src].make_ranges_local(
+                    AttnRanges([iv.grange])
+                )
+                start_pos = len(send_rows[iv.src][dst])
+                for lr in local_rows:
+                    send_rows[iv.src][dst].extend(range(lr.start, lr.end))
+                n = len(send_rows[iv.src][dst]) - start_pos
+                recv_parts[dst].append((iv.src, start_pos, n))
+
+        max_pair = max(
+            (len(send_rows[s][d]) for s in range(cp) for d in range(cp)),
+            default=0,
+        )
+        a_cap = _round_up(max(max_pair, 1), self.split_alignment)
+
+        send_idx = np.zeros((cp, cp, a_cap), dtype=np.int32)
+        send_counts = np.zeros((cp, cp), dtype=np.int32)
+        for s in range(cp):
+            for d in range(cp):
+                rows = send_rows[s][d]
+                send_counts[s, d] = len(rows)
+                if rows:
+                    send_idx[s, d, : len(rows)] = rows
+
+        r_max = recv_len_padded
+        recv_sel = np.zeros((cp, r_max), dtype=np.int32)
+        recv_len = np.zeros((cp,), dtype=np.int32)
+        for d in range(cp):
+            flat = []
+            for src, start_pos, n in recv_parts[d]:
+                flat.extend(src * a_cap + start_pos + i for i in range(n))
+            recv_len[d] = len(flat)
+            if flat:
+                recv_sel[d, : len(flat)] = flat
+
+        return GroupCollectiveArg(
+            transfer_table=transfer_table,
+            send_idx=send_idx,
+            send_counts=send_counts,
+            recv_sel=recv_sel,
+            recv_len=recv_len,
+            a_cap=a_cap,
+            r_max=r_max,
+        )
+
+
+def _local_to_global(own: AttnRanges, local_pos: int) -> int:
+    off = 0
+    for r in own:
+        if local_pos < off + r.seqlen:
+            return r.start + (local_pos - off)
+        off += r.seqlen
+    raise ValueError(f"local position {local_pos} out of range")
+
+
+def _find_interval(
+    ivs: list[_RemoteInterval], grange: AttnRange
+) -> _RemoteInterval:
+    for iv in ivs:
+        if grange.is_subrange_of(iv.grange):
+            return iv
+    raise ValueError(f"no merged interval contains {grange}")
