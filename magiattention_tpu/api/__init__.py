@@ -1,0 +1,21 @@
+"""User-facing API (ref: magi_attention/api/)."""
+
+from .functools import (  # noqa: F401
+    compute_pad_size,
+    full_attention_mask,
+    infer_attn_mask_from_cu_seqlens,
+    infer_attn_mask_from_sliding_window,
+    pad_at_dim,
+    squash_batch_dim,
+    unpad_at_dim,
+)
+from .magi_attn_interface import (  # noqa: F401
+    calc_attn,
+    clear_cache,
+    dispatch,
+    get_most_recent_key,
+    get_position_ids,
+    magi_attn_flex_key,
+    magi_attn_varlen_key,
+    undispatch,
+)

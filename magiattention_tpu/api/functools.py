@@ -1,0 +1,120 @@
+"""API helper functions (ref: magi_attention/api/functools.py).
+
+Mask compilers (cu_seqlens -> slices :335, sliding-window -> slices :180) and
+padding helpers (:27-178). Pure host code.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..common.enum import AttnMaskType
+from ..common.ranges import AttnRanges
+
+
+def compute_pad_size(
+    total_seqlen_q: int, cp_size: int, chunk_size: int
+) -> int:
+    """Rows to append so the sequence divides evenly into cp_size * chunks."""
+    block = cp_size * chunk_size
+    return (-total_seqlen_q) % block
+
+
+def infer_attn_mask_from_cu_seqlens(
+    cu_seqlens_q: Sequence[int],
+    cu_seqlens_k: Sequence[int] | None = None,
+    causal: bool = True,
+) -> tuple[AttnRanges, AttnRanges, list[AttnMaskType]]:
+    """Varlen (packed segments) mask -> slice metadata."""
+    q_ranges = AttnRanges.from_cu_seqlens(list(cu_seqlens_q))
+    k_ranges = (
+        AttnRanges.from_cu_seqlens(list(cu_seqlens_k))
+        if cu_seqlens_k is not None
+        else AttnRanges.from_ranges(q_ranges.to_naive_ranges())
+    )
+    if len(q_ranges) != len(k_ranges):
+        raise ValueError("cu_seqlens_q and cu_seqlens_k imply different counts")
+    t = AttnMaskType.CAUSAL if causal else AttnMaskType.FULL
+    return q_ranges, k_ranges, [t] * len(q_ranges)
+
+
+def infer_attn_mask_from_sliding_window(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_mask_type: list[AttnMaskType],
+    window_size: tuple[int, int],
+    sink_size: int = 0,
+) -> tuple[AttnRanges, AttnRanges, list[AttnMaskType]]:
+    """Compile per-segment sliding windows into slices (ref :180).
+
+    Args:
+        q_ranges/k_ranges/attn_mask_type: one entry per segment; currently
+            segments must be self-attending (q_range == k_range) with FULL or
+            CAUSAL type.
+        window_size: (left, right) window radius; -1 means unbounded on that
+            side (so (-1, -1) is FULL, (-1, 0) is CAUSAL).
+        sink_size: tokens at the start of each segment every query attends to.
+
+    Returns:
+        Decomposed (q_ranges, k_ranges, attn_mask_type) slice metadata.
+    """
+    out_q, out_k, out_t = AttnRanges(), AttnRanges(), []
+
+    def emit(qs, qe, ks, ke, t):
+        if qs < qe and ks < ke:
+            from ..common.range import AttnRange
+
+            out_q.append(AttnRange(qs, qe))
+            out_k.append(AttnRange(ks, ke))
+            out_t.append(t)
+
+    left, right = window_size
+    for qr, kr, mt in zip(q_ranges, k_ranges, attn_mask_type):
+        if (qr.start, qr.end) != (kr.start, kr.end):
+            raise ValueError("sliding window needs self-attending segments")
+        s, e = qr.start, qr.end
+        causal = mt == AttnMaskType.CAUSAL or right == 0
+        if not causal:
+            raise NotImplementedError(
+                "only causal sliding windows are compiled for now"
+            )
+        lw = left if left >= 0 else e - s
+        if sink_size > 0:
+            emit(s, e, s, s + sink_size, AttnMaskType.FULL)
+        # rows see [i-lw, i]: head part is plain causal, tail is bicausal
+        split = min(s + lw + 1, e)
+        emit(s, split, s, split, AttnMaskType.CAUSAL)
+        # BICAUSAL band: lo = ks - qs = -lw  => ks = qs - lw
+        #                hi = ke - qe = 0    => ke = qe
+        emit(split, e, split - lw, e, AttnMaskType.BICAUSAL)
+    return out_q, out_k, out_t
+
+
+def pad_at_dim(x, dim: int, pad: int, value=0.0):
+    """Append ``pad`` rows of ``value`` along ``dim``."""
+    import jax.numpy as jnp
+
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[dim] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def unpad_at_dim(x, dim: int, orig_len: int):
+    import jax
+
+    return jax.lax.slice_in_dim(x, 0, orig_len, axis=dim)
+
+
+def squash_batch_dim(x):
+    """(b, s, ...) -> (b*s, ...) — batch -> varlen packing (ref :54-92)."""
+    return x.reshape(-1, *x.shape[2:])
+
+
+def full_attention_mask(total_seqlen_q: int, total_seqlen_k: int, causal=False):
+    """Single-slice metadata covering the whole (sq, sk) plane."""
+    q_ranges = AttnRanges.from_ranges([(0, total_seqlen_q)])
+    k_ranges = AttnRanges.from_ranges([(0, total_seqlen_k)])
+    t = AttnMaskType.CAUSAL if causal else AttnMaskType.FULL
+    return q_ranges, k_ranges, [t]

@@ -1,0 +1,177 @@
+"""Primary user API (ref: magi_attention/api/magi_attn_interface.py).
+
+Same call surface as the reference — ``magi_attn_flex_key`` /
+``magi_attn_varlen_key`` plan a distributed mask and return a hashable key;
+``dispatch`` / ``calc_attn`` / ``undispatch`` execute against the cached
+runtime. Differences are TPU-native: a ``jax.sharding.Mesh`` (+ cp axis name)
+replaces the process group, and all ops are traceable jit-compatible
+functions over sharded global arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from ..common.enum import AttnMaskType
+from ..common.forward_meta import AttnForwardMeta
+from ..common.ranges import AttnRanges
+from ..config import DistAttnConfig
+from ..dist_attn_runtime_mgr import (
+    DistAttnRuntimeDict,
+    DistAttnRuntimeKey,
+    DistAttnRuntimeMgr,
+    _mesh_signature,
+)
+from ..env import snapshot_env
+from .functools import infer_attn_mask_from_cu_seqlens
+
+_runtime_dict = DistAttnRuntimeDict()
+_most_recent_key: DistAttnRuntimeKey | None = None
+
+
+def _auto_chunk_size(total_seqlen: int, cp_size: int) -> int:
+    """Pick the largest chunk <= 512 giving every rank >= 4 chunks and even
+    divisibility (ref :644-655 auto-derivation)."""
+    shard = total_seqlen // cp_size
+    target = min(512, max(1, shard // 4))
+    for cs in range(target, 0, -1):
+        if total_seqlen % (cs * cp_size) == 0:
+            return cs
+    return 1
+
+
+def magi_attn_flex_key(
+    q_ranges: AttnRanges | Sequence[Sequence[int]],
+    k_ranges: AttnRanges | Sequence[Sequence[int]],
+    attn_mask_type: Sequence[AttnMaskType | str | int],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    *,
+    mesh: Mesh,
+    cp_axis: str = "cp",
+    chunk_size: int | None = None,
+    dist_attn_config: DistAttnConfig | None = None,
+) -> DistAttnRuntimeKey:
+    """Plan a flexible-mask distributed attention; returns the runtime key.
+
+    The mask is ``(q_ranges, k_ranges, attn_mask_type)`` slice metadata in
+    global coordinates (ref :442). ``total_seqlen_q`` must be pre-padded to
+    divide ``cp_size * chunk_size`` (see :func:`compute_pad_size`).
+    """
+    global _most_recent_key
+    if not isinstance(q_ranges, AttnRanges):
+        q_ranges = AttnRanges.from_ranges(q_ranges)
+    if not isinstance(k_ranges, AttnRanges):
+        k_ranges = AttnRanges.from_ranges(k_ranges)
+    if total_seqlen_q != total_seqlen_k:
+        raise NotImplementedError(
+            "self-attention only for now (cross-attention in a later round)"
+        )
+    mask_ints = tuple(
+        AttnMaskType.normalize(t).to_int_type() for t in attn_mask_type
+    )
+    cp_size = mesh.shape[cp_axis]
+    if chunk_size is None:
+        chunk_size = (
+            dist_attn_config.dispatch_config.chunk_size
+            if dist_attn_config and dist_attn_config.dispatch_config.chunk_size
+            else _auto_chunk_size(total_seqlen_q, cp_size)
+        )
+    config = dist_attn_config or DistAttnConfig()
+
+    key = DistAttnRuntimeKey(
+        q_ranges=tuple(q_ranges.to_naive_ranges()),
+        k_ranges=tuple(k_ranges.to_naive_ranges()),
+        attn_mask_type=mask_ints,
+        total_seqlen_q=total_seqlen_q,
+        total_seqlen_k=total_seqlen_k,
+        chunk_size=chunk_size,
+        cp_size=cp_size,
+        cp_axis=cp_axis,
+        mesh_sig=_mesh_signature(mesh),
+        config=config,
+        env_snapshot=snapshot_env(),
+    )
+    _runtime_dict.get_or_create(key, mesh)
+    _most_recent_key = key
+    return key
+
+
+def magi_attn_varlen_key(
+    cu_seqlens_q: Sequence[int],
+    cu_seqlens_k: Sequence[int] | None = None,
+    *,
+    causal: bool = True,
+    mesh: Mesh,
+    cp_axis: str = "cp",
+    chunk_size: int | None = None,
+    dist_attn_config: DistAttnConfig | None = None,
+) -> DistAttnRuntimeKey:
+    """Varlen (cu_seqlens) convenience wrapper (ref :160)."""
+    q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
+        cu_seqlens_q, cu_seqlens_k, causal
+    )
+    return magi_attn_flex_key(
+        q_ranges,
+        k_ranges,
+        types,
+        total_seqlen_q=q_ranges.end,
+        total_seqlen_k=k_ranges.end,
+        mesh=mesh,
+        cp_axis=cp_axis,
+        chunk_size=chunk_size,
+        dist_attn_config=dist_attn_config,
+    )
+
+
+def _mgr(key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr:
+    mgr = _runtime_dict.get(key)
+    if mgr is None:
+        raise KeyError(
+            "unknown DistAttnRuntimeKey — create it with magi_attn_flex_key "
+            "in this process first"
+        )
+    return mgr
+
+
+def dispatch(
+    x: jax.Array, key: DistAttnRuntimeKey, role: str = "qo"
+) -> jax.Array:
+    """Global natural-order tensor -> dispatched cp-sharded layout (ref :892)."""
+    mgr = _mgr(key)
+    return mgr.dispatch_qo(x) if role == "qo" else mgr.dispatch_kv(x)
+
+
+def undispatch(
+    x: jax.Array, key: DistAttnRuntimeKey, role: str = "qo"
+) -> jax.Array:
+    """Dispatched layout -> global natural order (ref :929)."""
+    mgr = _mgr(key)
+    return mgr.undispatch_qo(x) if role == "qo" else mgr.undispatch_kv(x)
+
+
+def calc_attn(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    key: DistAttnRuntimeKey,
+) -> tuple[jax.Array, AttnForwardMeta]:
+    """Distributed attention over dispatched q/k/v (ref :1046)."""
+    out, lse = _mgr(key).calc_attn(q, k, v)
+    return out, AttnForwardMeta(lse=lse)
+
+
+def get_position_ids(key: DistAttnRuntimeKey) -> jax.Array:
+    """Global position of each dispatched row (for RoPE etc., ref :1117)."""
+    return _mgr(key).get_position_ids()
+
+
+def get_most_recent_key() -> DistAttnRuntimeKey | None:
+    return _most_recent_key
+
+
+def clear_cache() -> None:
+    _runtime_dict.clear()

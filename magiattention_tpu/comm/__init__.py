@@ -1,0 +1,16 @@
+"""Communication layer: group collectives over the device mesh.
+
+Ref: magi_attention/comm/ — the four reference backend tiers (NCCL a2av,
+hierarchical, native NVLink/NVSHMEM kernels, on-device a2av) collapse on TPU
+into ONE planning layer (meta/collection/comm_meta.py) lowered onto XLA
+collectives over ICI: ``jax.lax.all_to_all`` inside shard_map, with gathers
+computed from host-planned index arrays. XLA's async collective scheduling
+replaces the stream/event/KernelBarrier machinery (WorkWithPostProcessFn,
+csrc/extensions/kernel_barrier.cu).
+"""
+
+from .primitives import (  # noqa: F401
+    all_gather_v,
+    group_cast_rows,
+    group_reduce_rows,
+)

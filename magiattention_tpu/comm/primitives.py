@@ -1,0 +1,82 @@
+"""Group collective primitives (called INSIDE shard_map).
+
+Ref semantics (magi_attention/comm/primitive/grpcoll/_group_collective.py:81,255):
+  group_cast:   per-split multicast — every rank sends selected rows of its
+                local shard to a set of destination ranks; receivers assemble
+                their receive buffers in (src-rank, range) order.
+  group_reduce: the reverse — partials produced against a receive buffer are
+                sent back and reduced into the owners' shards (op=sum here;
+                the lse-weighted variant lives in functional/utils.py and is
+                applied before reduction by the qo-comm path).
+
+Lowering: host-planned index arrays (GroupCollectiveArg) + equal-split padded
+``jax.lax.all_to_all``. group_reduce is implemented as the exact linear
+transpose of group_cast, so jax AD of group_cast *is* group_reduce — the
+backward pass gets zero-redundant dkv reduction for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def group_cast_rows(
+    x: jax.Array,
+    send_idx: jax.Array,
+    recv_sel: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """GroupCast of shard rows. Must be called inside shard_map.
+
+    Args:
+        x: ``(shard, ...)`` local rows.
+        send_idx: ``(cp, A)`` local row indices to send to each destination
+            (padded with 0; receivers only select valid positions).
+        recv_sel: ``(R,)`` flat ``src*A + pos`` selectors assembling the
+            receive buffer.
+
+    Returns:
+        ``(R, ...)`` the remote rows this rank needs.
+    """
+    cp, a_cap = send_idx.shape
+    send = jnp.take(x, send_idx.reshape(-1), axis=0)
+    send = send.reshape(cp, a_cap, *x.shape[1:])
+    recv = jax.lax.all_to_all(
+        send, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+    flat = recv.reshape(cp * a_cap, *x.shape[1:])
+    return jnp.take(flat, recv_sel, axis=0)
+
+
+def group_reduce_rows(
+    y: jax.Array,
+    send_idx: jax.Array,
+    recv_sel: jax.Array,
+    axis_name: str,
+    shard_len: int,
+) -> jax.Array:
+    """GroupReduce (op=sum): exact transpose of :func:`group_cast_rows`.
+
+    Args:
+        y: ``(R, ...)`` partials against this rank's receive buffer.
+
+    Returns:
+        ``(shard, ...)`` sum of all partials targeting this rank's rows.
+    """
+    cp, a_cap = send_idx.shape
+    flat = jnp.zeros((cp * a_cap, *y.shape[1:]), dtype=y.dtype)
+    flat = flat.at[recv_sel].add(y)
+    recv = flat.reshape(cp, a_cap, *y.shape[1:])
+    back = jax.lax.all_to_all(
+        recv, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+    out = jnp.zeros((shard_len, *y.shape[1:]), dtype=y.dtype)
+    return out.at[send_idx.reshape(-1)].add(
+        back.reshape(cp * a_cap, *y.shape[1:])
+    )
+
+
+def all_gather_v(x: jax.Array, axis_name: str) -> jax.Array:
+    """Gather all shards along axis 0 (equal shard sizes). Inside shard_map."""
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)

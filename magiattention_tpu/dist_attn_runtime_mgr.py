@@ -1,0 +1,155 @@
+"""Runtime key + manager (ref: magi_attention/dist_attn_runtime_mgr.py:62,164).
+
+``DistAttnRuntimeKey`` is a frozen hashable key over (mask metadata, mesh
+signature, chunking, config, env-flag snapshot); ``DistAttnRuntimeMgr`` owns
+the planning pipeline output (dispatch meta -> attn meta -> DistAttnRuntime)
+and the dispatch/undispatch/calc_attn methods. Managers are memoized in an
+LRU keyed by the runtime key — this is what caches traced/compiled plans
+across steps.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+from jax.sharding import Mesh
+
+from .common.enum import AttnMaskType, AttnType
+from .common.ranges import AttnRanges
+from .config import DistAttnConfig
+from .env import general as env_general
+from .functional.dispatch import dispatch_func, undispatch_func
+from .functional.dist_attn import DistAttnRuntime
+from .meta import (
+    make_attn_meta_from_dispatch_meta,
+    make_dispatch_meta_from_qk_ranges,
+)
+
+
+def _mesh_signature(mesh: Mesh) -> tuple:
+    return (
+        tuple(mesh.axis_names),
+        tuple(mesh.devices.shape),
+        tuple(d.id for d in mesh.devices.flat),
+    )
+
+
+@dataclass(frozen=True)
+class DistAttnRuntimeKey:
+    """Hashable identity of one planned runtime (ref :62-121)."""
+
+    q_ranges: tuple[tuple[int, int], ...]
+    k_ranges: tuple[tuple[int, int], ...]
+    attn_mask_type: tuple[int, ...]
+    total_seqlen_q: int
+    total_seqlen_k: int
+    chunk_size: int
+    cp_size: int
+    cp_axis: str
+    mesh_sig: tuple
+    config: DistAttnConfig
+    env_snapshot: tuple
+
+
+class DistAttnRuntimeMgr:
+    """Owns metas + runtime for one key (ref :164-483)."""
+
+    def __init__(self, key: DistAttnRuntimeKey, mesh: Mesh) -> None:
+        self.key = key
+        self.mesh = mesh
+        q_ranges = AttnRanges.from_ranges(key.q_ranges)
+        k_ranges = AttnRanges.from_ranges(key.k_ranges)
+        mask_types = [AttnMaskType.from_int_type(t) for t in key.attn_mask_type]
+
+        self.dispatch_meta_q, self.dispatch_meta_kv, self.bucket = (
+            make_dispatch_meta_from_qk_ranges(
+                q_ranges,
+                k_ranges,
+                mask_types,
+                key.total_seqlen_q,
+                key.total_seqlen_k,
+                key.chunk_size,
+                key.cp_size,
+                key.config.dispatch_config,
+            )
+        )
+        self.comm_meta, self.calc_meta = make_attn_meta_from_dispatch_meta(
+            self.bucket, self.dispatch_meta_q, key.config
+        )
+        overlap_cfg = key.config.overlap_config
+        self.runtime = DistAttnRuntime(
+            comm_meta=self.comm_meta,
+            calc_meta=self.calc_meta,
+            mesh=mesh,
+            cp_axis=key.cp_axis,
+            # auto (overlap iff the solver produced >1 stage) when enabled,
+            # forced single merged kernel when disabled
+            use_overlap=None if overlap_cfg.enable else False,
+        )
+
+    # -- ops ---------------------------------------------------------------
+
+    def dispatch_qo(self, x: jax.Array) -> jax.Array:
+        return dispatch_func(
+            x, self.dispatch_meta_q.position_ids, self.mesh, self.key.cp_axis
+        )
+
+    def dispatch_kv(self, x: jax.Array) -> jax.Array:
+        return dispatch_func(
+            x, self.dispatch_meta_kv.position_ids, self.mesh, self.key.cp_axis
+        )
+
+    def undispatch_qo(self, x: jax.Array) -> jax.Array:
+        return undispatch_func(
+            x, self.dispatch_meta_q.unpermute_index, self.mesh, self.key.cp_axis
+        )
+
+    def undispatch_kv(self, x: jax.Array) -> jax.Array:
+        return undispatch_func(
+            x, self.dispatch_meta_kv.unpermute_index, self.mesh, self.key.cp_axis
+        )
+
+    def calc_attn(
+        self, q: jax.Array, k: jax.Array, v: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        return self.runtime.calc_attn(q, k, v)
+
+    def get_position_ids(self) -> jax.Array:
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.dispatch_meta_q.position_ids.reshape(-1))
+
+    def get_xattn_args(self) -> Any:
+        raise NotImplementedError("cross-attention args arrive in a later round")
+
+
+class DistAttnRuntimeDict:
+    """LRU cache of managers (ref :412; api/magi_attn_interface.py:64)."""
+
+    def __init__(self, maxsize: int | None = None) -> None:
+        self.maxsize = maxsize or env_general.runtime_dict_size()
+        self._d: OrderedDict[DistAttnRuntimeKey, DistAttnRuntimeMgr] = OrderedDict()
+
+    def get_or_create(
+        self, key: DistAttnRuntimeKey, mesh: Mesh
+    ) -> DistAttnRuntimeMgr:
+        if key in self._d:
+            self._d.move_to_end(key)
+            return self._d[key]
+        mgr = DistAttnRuntimeMgr(key, mesh)
+        self._d[key] = mgr
+        while len(self._d) > self.maxsize:
+            self._d.popitem(last=False)
+        return mgr
+
+    def get(self, key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr | None:
+        return self._d.get(key)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+    def __len__(self) -> int:
+        return len(self._d)

@@ -1,0 +1,330 @@
+"""The CP engine (ref: magi_attention/functional/dist_attn.py:142,3101).
+
+``DistAttnRuntime`` turns the solver's host plans (CommMeta + CalcMeta) into a
+single SPMD function over the CP mesh axis:
+
+- no-overlap path (ref :3305): GroupCast all remote kv, concatenate with the
+  local shard, run ONE merged FFA kernel. Simplest, fewest launches.
+- multi-stage overlap path (ref :3195-3266): run the host kernel and one FFA
+  per stage against that stage's receive buffer, lse-merging partials. The
+  per-stage all_to_alls have no data dependence on earlier compute, so XLA's
+  async collective scheduler hides stage i+1's communication under stage i's
+  compute — replacing the reference's stream/event + KernelBarrier machinery.
+
+Backward: jax AD. The kernel has a custom VJP (Pallas dq/dkv kernels); the
+GroupCast gathers + all_to_all transpose to scatter-add + reverse all_to_all,
+which IS GroupReduce — zero-redundant dkv reduction with no hand-written comm
+(replacing _reduce_partial_dkv, ref :2123). The lse-merge transposes through
+jnp autodiff (replacing _reduce_partial_out_lse, ref :1979).
+
+SPMD note: per-rank metadata (slice lists, index arrays, FFA plans) is padded
+to rank-uniform shapes and passed as sharded operands, so one traced program
+serves every rank — the TPU answer to the reference's per-rank host code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..comm.primitives import group_cast_rows
+from ..env import general as env_general
+from ..kernels.ffa import (
+    FFAParams,
+    _ffa_bwd_dkv_pallas,
+    _ffa_bwd_dq_pallas,
+    _ffa_fwd_pallas,
+    _should_interpret,
+    ffa_attn_with_plan,
+)
+from ..kernels.ffa_plan import build_ffa_plan, pad_plan
+from ..meta.collection.calc_meta import AttnArg, CalcMeta
+from ..meta.collection.comm_meta import CommMeta
+from .utils import lse_weighted_reduce
+
+
+def _head_major(x: jax.Array, sp: int) -> jax.Array:
+    """(s, h, d) -> (h, sp, d) padded to sp rows."""
+    return jnp.pad(x, ((0, sp - x.shape[0]), (0, 0), (0, 0))).transpose(1, 0, 2)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _multi_ffa(q, ks, vs, arrays_list, params_list):
+    """Merged multi-part FFA: part i attends q against (ks[i], vs[i]) with its
+    own plan; partials are lse-merged into one (out, lse).
+
+    The VJP is the distributed-flash identity (ref dist_attn.py bwd loop
+    :3561): each part's backward kernel runs against the FINAL merged lse and
+    delta = rowsum(do * out_final), which makes per-part dq/dkv contributions
+    exact — no gradient flows through the merge weights themselves.
+    """
+    out, lse, _, _ = _multi_ffa_impl(q, ks, vs, arrays_list, params_list)
+    return out, lse
+
+
+def _multi_ffa_impl(q, ks, vs, arrays_list, params_list):
+    outs, lses = [], []
+    qts = []
+    for k, v, arrs, prm in zip(ks, vs, arrays_list, params_list):
+        sqp = prm.num_q_tiles * prm.block_q
+        skp = prm.num_k_tiles * prm.block_k
+        q_t = _head_major(q, sqp)
+        k_t = _head_major(k, skp)
+        v_t = _head_major(v, skp)
+        out_t, lse_t = _ffa_fwd_pallas(prm, *arrs[:3], q_t, k_t, v_t)
+        outs.append(out_t.transpose(1, 0, 2)[: q.shape[0]])
+        lses.append(lse_t.T[: q.shape[0]])
+        qts.append(q_t)
+    out, lse = lse_weighted_reduce(jnp.stack(outs), jnp.stack(lses))
+    return out, lse, outs, lses
+
+
+def _multi_ffa_fwd(q, ks, vs, arrays_list, params_list):
+    out, lse, _, _ = _multi_ffa_impl(q, ks, vs, arrays_list, params_list)
+    return (out, lse), (q, ks, vs, out, lse, arrays_list)
+
+
+def _multi_ffa_bwd(params_list, res, cts):
+    do, _ = cts  # lse cotangent ignored (auxiliary output)
+    q, ks, vs, out, lse, arrays_list = res
+    sq = q.shape[0]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (sq, hq)
+
+    dq_total = None
+    dks, dvs = [], []
+    for k, v, arrs, prm in zip(ks, vs, arrays_list, params_list):
+        sqp = prm.num_q_tiles * prm.block_q
+        skp = prm.num_k_tiles * prm.block_k
+        q_t = _head_major(q, sqp)
+        k_t = _head_major(k, skp)
+        v_t = _head_major(v, skp)
+        do_t = _head_major(do, sqp)
+        # pad lse with -inf, delta with 0 for rows beyond sq
+        lse_t = jnp.pad(
+            lse, ((0, sqp - sq), (0, 0)), constant_values=float("-inf")
+        ).T
+        delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
+        dq_t = _ffa_bwd_dq_pallas(
+            prm, *arrs[:3], q_t, k_t, v_t, do_t, lse_t, delta_t
+        )
+        dk_t, dv_t = _ffa_bwd_dkv_pallas(
+            prm, *arrs[3:6], q_t, k_t, v_t, do_t, lse_t, delta_t
+        )
+        g = prm.group
+        if g > 1:
+            hq, skp_, dh = dk_t.shape
+            dk_t = dk_t.reshape(hq // g, g, skp_, dh).sum(axis=1)
+            dv_t = dv_t.reshape(hq // g, g, skp_, dv_t.shape[-1]).sum(axis=1)
+        dq = dq_t.transpose(1, 0, 2)[:sq].astype(q.dtype)
+        dq_total = dq if dq_total is None else dq_total + dq
+        dks.append(dk_t.transpose(1, 0, 2)[: k.shape[0]].astype(k.dtype))
+        dvs.append(dv_t.transpose(1, 0, 2)[: v.shape[0]].astype(v.dtype))
+    return dq_total, tuple(dks), tuple(dvs), None
+
+
+_multi_ffa.defvjp(_multi_ffa_fwd, _multi_ffa_bwd)
+
+
+def _stack_plans(args: list[AttnArg], sq: int, sk: int, bq: int, bk: int):
+    """Per-rank FFA plans -> rank-stacked arrays padded to a common size."""
+    plans = [
+        build_ffa_plan(
+            a.q_ranges, a.k_ranges, a.d_lo, a.d_hi, sq, sk, bq, bk
+        )
+        for a in args
+    ]
+    w = max(p.num_work for p in plans)
+    wt = max(p.num_work_t for p in plans)
+    padded = [pad_plan(p, w, wt) for p in plans]
+    stacked = tuple(
+        jnp.asarray(np.stack([getattr(p, f) for p in padded]))
+        for f in ("work_qt", "work_kt", "meta", "work_qt_t", "work_kt_t",
+                  "meta_t")
+    )
+    return stacked, plans[0].num_q_tiles, plans[0].num_k_tiles, w, wt
+
+
+@dataclass(eq=False)
+class DistAttnRuntime:
+    """Compiled-plan holder for one (mask, mesh, config) combination."""
+
+    comm_meta: CommMeta
+    calc_meta: CalcMeta
+    mesh: Mesh
+    cp_axis: str
+    softmax_scale: float | None = None
+    softcap: float = 0.0
+    block_q: int | None = None
+    block_k: int | None = None
+    use_overlap: bool | None = None  # None -> overlap iff >1 stage
+
+    def __post_init__(self) -> None:
+        from ..kernels.ffa import default_blocks
+
+        cm, km = self.comm_meta, self.calc_meta
+        self.cp_size = len(km.host_args)
+        shard = km.shard_len
+        total_recv = sum(km.recv_len_per_stage)
+        self.num_stages = len(cm.kv_stages)
+        if self.use_overlap is None:
+            self.use_overlap = self.num_stages > 1
+
+        bq, bk = default_blocks(
+            shard, shard + total_recv, self.block_q, self.block_k
+        )
+        self._bq, self._bk = bq, bk
+
+        # merged (no-overlap) plan
+        (self._merged_arrays, nqt, nkt, w, wt) = _stack_plans(
+            km.merged_args, shard, shard + total_recv, bq, bk
+        )
+        self._merged_dims = (nqt, nkt, w, wt)
+
+        if self.use_overlap:
+            (self._host_arrays, hnqt, hnkt, hw, hwt) = _stack_plans(
+                km.host_args, shard, shard, bq, min(bk, _ceil_to(shard, 128))
+            )
+            self._host_dims = (hnqt, hnkt, hw, hwt)
+            self._stage_arrays = []
+            self._stage_dims = []
+            for st in range(self.num_stages):
+                rl = km.recv_len_per_stage[st]
+                sa, snqt, snkt, sw, swt = _stack_plans(
+                    km.remote_args_per_stage[st], shard, rl,
+                    bq, min(bk, _ceil_to(rl, 128)),
+                )
+                self._stage_arrays.append(sa)
+                self._stage_dims.append((snqt, snkt, sw, swt))
+
+        # comm arrays (host-planned, stacked over ranks)
+        self._send_idx = [
+            jnp.asarray(s.send_idx) for s in cm.kv_stages
+        ]  # each (cp, cp, A)
+        self._recv_sel = [
+            jnp.asarray(s.recv_sel) for s in cm.kv_stages
+        ]  # each (cp, R)
+
+    # ------------------------------------------------------------------
+
+    def _ffa_params(self, dims, scale, group) -> FFAParams:
+        nqt, nkt, w, wt = dims
+        return FFAParams(
+            num_work=w, num_work_t=wt, num_q_tiles=nqt, num_k_tiles=nkt,
+            block_q=self._bq, block_k=self._bk,
+            softmax_scale=scale, softcap=self.softcap, group=group,
+            interpret=_should_interpret(),
+        )
+
+    def calc_attn(
+        self, q: jax.Array, k: jax.Array, v: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """Distributed attention over dispatched tensors.
+
+        Args:
+            q/k/v: ``(cp*shard, h, d)`` dispatched (permuted) layout, sharded
+                over the cp mesh axis on dim 0.
+
+        Returns:
+            (out ``(cp*shard, hq, dv)``, lse ``(cp*shard, hq)`` fp32), same
+            sharded layout.
+        """
+        sq, hq, dh = q.shape
+        _, hk, dv = v.shape
+        group = hq // hk
+        scale = (
+            float(dh) ** -0.5
+            if self.softmax_scale is None
+            else self.softmax_scale
+        )
+        axis = self.cp_axis
+        spec = P(axis)
+
+        if not self.use_overlap:
+            params = self._ffa_params(self._merged_dims, scale, group)
+
+            def f(q, k, v, send_idxs, recv_sels, arrays):
+                kv_parts_k, kv_parts_v = [k], [v]
+                for si, rs in zip(send_idxs, recv_sels):
+                    kv_parts_k.append(
+                        group_cast_rows(k, si[0], rs[0], axis)
+                    )
+                    kv_parts_v.append(
+                        group_cast_rows(v, si[0], rs[0], axis)
+                    )
+                k_all = jnp.concatenate(kv_parts_k, axis=0)
+                v_all = jnp.concatenate(kv_parts_v, axis=0)
+                local_arrays = tuple(a[0] for a in arrays)
+                out, lse = ffa_attn_with_plan(q, k_all, v_all, local_arrays, params)
+                return out, lse
+
+            fn = shard_map(
+                f,
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec,
+                          [P(axis) for _ in self._send_idx],
+                          [P(axis) for _ in self._recv_sel],
+                          tuple(P(axis) for _ in self._merged_arrays)),
+                out_specs=(spec, spec),
+                check_vma=False,
+            )
+            return fn(q, k, v, self._send_idx, self._recv_sel,
+                      self._merged_arrays)
+
+        # multi-stage overlap path
+        host_params = self._ffa_params(self._host_dims, scale, group)
+        stage_params = [
+            self._ffa_params(d, scale, group) for d in self._stage_dims
+        ]
+
+        all_params = (host_params, *stage_params)
+
+        def f(q, k, v, send_idxs, recv_sels, host_arrays, stage_arrays):
+            # issue every stage's collective up front: no data dependence on
+            # compute, XLA overlaps them with the host + earlier-stage kernels
+            ks, vs = [k], [v]
+            for si, rs in zip(send_idxs, recv_sels):
+                ks.append(group_cast_rows(k, si[0], rs[0], axis))
+                vs.append(group_cast_rows(v, si[0], rs[0], axis))
+            arrays_list = (tuple(a[0] for a in host_arrays),) + tuple(
+                tuple(a[0] for a in sa) for sa in stage_arrays
+            )
+            return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, all_params)
+
+        fn = shard_map(
+            f,
+            mesh=self.mesh,
+            in_specs=(spec, spec, spec,
+                      [P(axis) for _ in self._send_idx],
+                      [P(axis) for _ in self._recv_sel],
+                      tuple(P(axis) for _ in self._host_arrays),
+                      [tuple(P(axis) for _ in sa) for sa in self._stage_arrays]),
+            out_specs=(spec, spec),
+            check_vma=False,
+        )
+        return fn(q, k, v, self._send_idx, self._recv_sel,
+                  self._host_arrays, self._stage_arrays)
+
+
+def dist_attn_func(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    runtime: DistAttnRuntime,
+) -> tuple[jax.Array, jax.Array]:
+    """Functional entry (ref dist_attn.py:3714): (out, lse) over dispatched
+    tensors. Precision override via MAGI_ATTENTION_PRECISION."""
+    if env_general.precision() == "bf16":
+        q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    return runtime.calc_attn(q, k, v)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return max(m, -(-x // m) * m)
