@@ -1,0 +1,5 @@
+"""Model layer: reference integrations live in examples/ for the reference
+(Llama-3 + FSDP/Megatron/Transformers, ref examples/); here the flagship
+model is a JAX-native Llama with CP attention built in."""
+
+from .llama import LlamaConfig, forward, init_params, train_step  # noqa: F401

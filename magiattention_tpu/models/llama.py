@@ -1,0 +1,188 @@
+"""Llama-style transformer with context-parallel flex attention.
+
+The TPU-native counterpart of the reference's examples/torch_native Llama-3
+integration (ref examples/torch_native/README.md:75-90 — FSDP2 over a dp_cp
+mesh): a packed-varlen (no batch dim) decoder where attention runs through
+``magi_attn_flex_key -> dispatch -> calc_attn`` and every non-attention op is
+row-wise or a matmul, so the whole network computes directly on the
+dispatched (chunk-permuted, cp-sharded) layout. RoPE uses the dispatched
+global position ids. Parameters are ZeRO-3-style sharded over the cp axis
+(the FSDP equivalent), gathered on demand by XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..api import calc_attn, dispatch, get_position_ids
+from ..dist_attn_runtime_mgr import DistAttnRuntimeKey
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    head_dim: int = 64
+    ffn_hidden: int = 1408
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Random-init parameter pytree (fp32 master weights)."""
+    ks = jax.random.split(key, 2 + cfg.n_layers)
+    dim, dh = cfg.dim, cfg.head_dim
+    hq, hk = cfg.n_heads, cfg.n_kv_heads
+
+    def dense(k, shape):
+        return jax.random.normal(k, shape, dtype=jnp.float32) * (
+            shape[0] ** -0.5
+        )
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(ks[2 + i], 7)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((dim,), jnp.float32),
+                "wq": dense(lk[0], (dim, hq * dh)),
+                "wk": dense(lk[1], (dim, hk * dh)),
+                "wv": dense(lk[2], (dim, hk * dh)),
+                "wo": dense(lk[3], (hq * dh, dim)),
+                "mlp_norm": jnp.ones((dim,), jnp.float32),
+                "w_gate": dense(lk[4], (dim, cfg.ffn_hidden)),
+                "w_up": dense(lk[5], (dim, cfg.ffn_hidden)),
+                "w_down": dense(lk[6], (cfg.ffn_hidden, dim)),
+            }
+        )
+    return {
+        "embed": dense(ks[0], (cfg.vocab_size, dim)),
+        "final_norm": jnp.ones((dim,), jnp.float32),
+        "lm_head": dense(ks[1], (dim, cfg.vocab_size)),
+        "layers": layers,
+    }
+
+
+def shard_params(params: dict, mesh: Mesh, axis: str = "cp") -> dict:
+    """ZeRO-3-style: shard every matrix's first dim over the cp axis."""
+
+    def s(x):
+        if x.ndim >= 2 and x.shape[0] % mesh.shape[axis] == 0:
+            return jax.device_put(
+                x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+            )
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree.map(s, params)
+
+
+def _rms_norm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x, pos, theta):
+    """x: (S, h, dh); pos: (S,) global positions."""
+    s, h, dh = x.shape
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, half)
+    cos, sin = jnp.cos(ang)[:, None, :], jnp.sin(ang)[:, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    x32_1, x32_2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [x32_1 * cos - x32_2 * sin, x32_1 * sin + x32_2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def forward(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    attn_key: DistAttnRuntimeKey,
+) -> jax.Array:
+    """Forward pass on the dispatched layout.
+
+    Args:
+        tokens: ``(total_seqlen,)`` int32, natural order.
+
+    Returns:
+        logits ``(total_seqlen, vocab)`` in DISPATCHED order (use
+        ``undispatch`` for natural order; the training loss dispatches labels
+        instead, which is cheaper).
+    """
+    dt = cfg.jdtype
+    x = jnp.take(params["embed"], tokens, axis=0).astype(dt)  # (S, dim)
+    x = dispatch(x, attn_key)
+    pos = get_position_ids(attn_key)
+
+    for lyr in params["layers"]:
+        h = _rms_norm(x, lyr["attn_norm"], cfg.norm_eps)
+        q = (h @ lyr["wq"].astype(dt)).reshape(-1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lyr["wk"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lyr["wv"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        attn_out, _ = calc_attn(q, k, v, attn_key)
+        attn_out = attn_out.reshape(-1, cfg.n_heads * cfg.head_dim)
+        x = x + attn_out @ lyr["wo"].astype(dt)
+
+        h = _rms_norm(x, lyr["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lyr["w_gate"].astype(dt))
+        up = h @ lyr["w_up"].astype(dt)
+        x = x + (gate * up) @ lyr["w_down"].astype(dt)
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    labels: jax.Array,
+    attn_key: DistAttnRuntimeKey,
+) -> jax.Array:
+    """Next-token cross entropy, computed on the dispatched layout (labels
+    are dispatched with the same permutation — cheaper than undispatching
+    the logits)."""
+    logits = forward(params, cfg, tokens, attn_key)
+    labels_d = dispatch(labels, attn_key)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels_d[:, None], axis=-1)[:, 0]
+    valid = labels_d >= 0
+    return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+        jnp.sum(valid), 1
+    )
+
+
+@partial(jax.jit, static_argnums=(1, 4), donate_argnums=(0,))
+def train_step(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    labels: jax.Array,
+    attn_key: DistAttnRuntimeKey,
+    lr: float = 1e-4,
+) -> tuple[dict, jax.Array]:
+    """One SGD step (the examples pair this with optax in practice)."""
+    loss, grads = jax.value_and_grad(loss_fn)(
+        params, cfg, tokens, labels, attn_key
+    )
+    params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+    return params, loss
