@@ -1,0 +1,219 @@
+// Host-side native backend for magiattention_tpu.
+//
+// TPU-native counterpart of the reference's C++ host extension
+// (magi_attention/csrc/extensions/: attn_ranges.hpp, rectangle.hpp,
+// dyn_solver_alg.cpp) — the planning hot loops that dominate key-init time
+// for long sequences: range algebra over (n,2) int32 buffers, closed-form
+// band areas, per-chunk workload computation, and the greedy dispatch solve.
+// Exposed through a plain C ABI consumed via ctypes (no pybind11 in the
+// image); buffers are caller-allocated numpy arrays.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// band geometry
+// ---------------------------------------------------------------------------
+
+// Number of unmasked (i, j) pairs with i in [i0,i1), j in [j0,j1),
+// lo <= j - i <= hi. Closed form via segment decomposition: the per-row
+// count f(i) = min(j1-1, i+hi) - max(j0, i+lo) + 1 (clamped at 0) is
+// piecewise linear with breakpoints where each min/max switches branch.
+int64_t magi_band_area(int64_t i0, int64_t i1, int64_t j0, int64_t j1,
+                       int64_t lo, int64_t hi) {
+  if (i0 >= i1 || j0 >= j1 || lo > hi) return 0;
+  // segment boundaries (sorted, clipped to [i0, i1))
+  int64_t bps[6] = {i0, i1, j1 - hi, j0 - lo, j1 - lo, j0 - hi};
+  std::sort(bps, bps + 6);
+  int64_t total = 0;
+  for (int s = 0; s < 5; ++s) {
+    int64_t a = std::max(bps[s], i0);
+    int64_t b = std::min(bps[s + 1], i1);
+    if (a >= b) continue;
+    // f is linear on [a, b): evaluate at both ends
+    auto f = [&](int64_t i) -> int64_t {
+      int64_t top = std::min(j1 - 1, i + hi);
+      int64_t bot = std::max(j0, i + lo);
+      return top - bot + 1;
+    };
+    int64_t fa = f(a), fb = f(b - 1);
+    if (fa <= 0 && fb <= 0) continue;
+    if (fa > 0 && fb > 0) {
+      total += (fa + fb) * (b - a) / 2;  // arithmetic series
+      continue;
+    }
+    // f is linear with slope in {-1, 0, +1} and crosses zero inside the
+    // segment: the positive part is a triangular series at one end
+    if (fa > 0) {
+      total += fa * (fa + 1) / 2;  // decreasing: fa, fa-1, ..., 1
+    } else {
+      total += fb * (fb + 1) / 2;  // increasing tail: 1, ..., fb
+    }
+  }
+  return total;
+}
+
+// Per-chunk attention areas: for chunk c in [0, num_chunks), sum over slices
+// of the band area restricted to q rows [c*chunk, (c+1)*chunk).
+// slices: (n, 6) int64 rows (qs, qe, ks, ke, lo, hi).
+void magi_chunk_areas(const int64_t* slices, int64_t n_slices,
+                      int64_t chunk_size, int64_t num_chunks,
+                      int64_t* out_areas) {
+  std::memset(out_areas, 0, sizeof(int64_t) * num_chunks);
+  for (int64_t s = 0; s < n_slices; ++s) {
+    const int64_t* r = slices + s * 6;
+    int64_t qs = r[0], qe = r[1], ks = r[2], ke = r[3], lo = r[4], hi = r[5];
+    if (qs >= qe || ks >= ke || lo > hi) continue;
+    int64_t c0 = qs / chunk_size;
+    int64_t c1 = (qe + chunk_size - 1) / chunk_size;
+    if (c1 > num_chunks) c1 = num_chunks;
+    for (int64_t c = c0; c < c1; ++c) {
+      int64_t i0 = std::max(qs, c * chunk_size);
+      int64_t i1 = std::min(qe, (c + 1) * chunk_size);
+      out_areas[c] += magi_band_area(i0, i1, ks, ke, lo, hi);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// range algebra over (n, 2) int32 buffers
+// ---------------------------------------------------------------------------
+
+// Sort by (start, end), drop empties, coalesce overlapping/adjacent.
+// Returns the number of merged ranges written to `out` (capacity >= n).
+int64_t magi_ranges_merge(const int32_t* ranges, int64_t n, int32_t* out) {
+  std::vector<std::pair<int32_t, int32_t>> rs;
+  rs.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t s = ranges[2 * i], e = ranges[2 * i + 1];
+    if (s < e) rs.emplace_back(s, e);
+  }
+  std::sort(rs.begin(), rs.end());
+  int64_t m = 0;
+  for (auto& [s, e] : rs) {
+    if (m > 0 && s <= out[2 * (m - 1) + 1]) {
+      out[2 * (m - 1) + 1] = std::max(out[2 * (m - 1) + 1], e);
+    } else {
+      out[2 * m] = s;
+      out[2 * m + 1] = e;
+      ++m;
+    }
+  }
+  return m;
+}
+
+// Coverage of `a` (merged) not covered by `b` (merged). Capacity of out:
+// na + nb ranges. Returns count.
+int64_t magi_ranges_holes(const int32_t* a, int64_t na, const int32_t* b,
+                          int64_t nb, int32_t* out) {
+  int64_t m = 0, j = 0;
+  for (int64_t i = 0; i < na; ++i) {
+    int32_t cur = a[2 * i], end = a[2 * i + 1];
+    while (j < nb && b[2 * j + 1] <= cur) ++j;
+    int64_t k = j;
+    while (k < nb && b[2 * k] < end) {
+      if (b[2 * k] > cur) {
+        out[2 * m] = cur;
+        out[2 * m + 1] = b[2 * k];
+        ++m;
+      }
+      cur = std::max(cur, b[2 * k + 1]);
+      if (cur >= end) break;
+      ++k;
+    }
+    if (cur < end) {
+      out[2 * m] = cur;
+      out[2 * m + 1] = end;
+      ++m;
+    }
+  }
+  return m;
+}
+
+// Coverage intersection of two merged range lists. Capacity na + nb.
+int64_t magi_ranges_overlap(const int32_t* a, int64_t na, const int32_t* b,
+                            int64_t nb, int32_t* out) {
+  int64_t m = 0, i = 0, j = 0;
+  while (i < na && j < nb) {
+    int32_t s = std::max(a[2 * i], b[2 * j]);
+    int32_t e = std::min(a[2 * i + 1], b[2 * j + 1]);
+    if (s < e) {
+      out[2 * m] = s;
+      out[2 * m + 1] = e;
+      ++m;
+    }
+    if (a[2 * i + 1] < b[2 * j + 1]) ++i; else ++j;
+  }
+  return m;
+}
+
+// Map global sub-ranges into the local (concatenated) coordinates of `host`
+// (merged), splitting at host-piece boundaries. Returns count, or -1 if some
+// input range is not fully covered. Capacity: n + n_host per input range.
+int64_t magi_ranges_make_local(const int32_t* host, int64_t nh,
+                               const int32_t* ranges, int64_t n,
+                               int32_t* out) {
+  std::vector<int64_t> offsets(nh);
+  int64_t off = 0;
+  for (int64_t i = 0; i < nh; ++i) {
+    offsets[i] = off;
+    off += host[2 * i + 1] - host[2 * i];
+  }
+  int64_t m = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    int32_t s = ranges[2 * r], e = ranges[2 * r + 1];
+    if (s >= e) continue;
+    int64_t covered = 0;
+    for (int64_t h = 0; h < nh; ++h) {
+      int32_t hs = host[2 * h], he = host[2 * h + 1];
+      int32_t is = std::max(s, hs), ie = std::min(e, he);
+      if (is >= ie) continue;
+      out[2 * m] = static_cast<int32_t>(offsets[h] + (is - hs));
+      out[2 * m + 1] = static_cast<int32_t>(offsets[h] + (ie - hs));
+      ++m;
+      covered += ie - is;
+    }
+    if (covered != e - s) return -1;
+  }
+  return m;
+}
+
+// ---------------------------------------------------------------------------
+// dispatch solver hot loop (min-heap greedy, equal chunk counts)
+// ---------------------------------------------------------------------------
+
+// areas: (n,) int64; out_assign: (n,) int32 rank per chunk.
+void magi_minheap_solve(const int64_t* areas, int64_t n, int64_t cp,
+                        int64_t per_rank, int32_t* out_assign) {
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(),
+            [&](int64_t x, int64_t y) { return areas[x] > areas[y]; });
+  using Item = std::pair<int64_t, int64_t>;  // (load, rank)
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> heap;
+  std::vector<int64_t> count(cp, 0);
+  for (int64_t r = 0; r < cp; ++r) heap.emplace(0, r);
+  std::vector<Item> overflow;
+  for (int64_t idx : order) {
+    while (true) {
+      auto [load, r] = heap.top();
+      heap.pop();
+      if (count[r] < per_rank) {
+        out_assign[idx] = static_cast<int32_t>(r);
+        ++count[r];
+        heap.emplace(load + areas[idx], r);
+        break;
+      }
+      overflow.emplace_back(load, r);
+    }
+    for (auto& it : overflow) heap.push(it);
+    overflow.clear();
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,14 @@
+"""Native host backend: JIT-built C++ planning hot loops + ctypes bindings.
+
+Counterpart of the reference's C++ common backend + JIT build system
+(magi_attention/common/jit/core.py:244, csrc/extensions/magi_attn_ext.cpp):
+``csrc/magi_host.cpp`` is compiled on first use with g++ into a cache
+directory keyed by source hash (rebuilds automatically when the source
+changes), then bound through ctypes. ``CppAttnRange``/``CppAttnRanges``
+conform to ``common.protocols`` and are swapped in by ``common/__init__``
+when ``MAGI_ATTENTION_CPP_BACKEND=1`` (default).
+"""
+
+from .build import get_lib  # noqa: F401
+from .ranges import CppAttnRange, CppAttnRanges  # noqa: F401
+from .ops import band_area_native, chunk_areas_native, minheap_solve_native  # noqa: F401

@@ -1,0 +1,81 @@
+"""JIT C++ build core (ref: magi_attention/common/jit/core.py).
+
+Compiles csrc/magi_host.cpp with g++ -O3 into a per-source-hash cache dir
+(MAGI_ATTENTION_JIT_CACHE_DIR, default ~/.cache/magiattention_tpu) and loads
+it via ctypes. Thread-safe single build per process; a failed toolchain
+falls back to the pure-Python implementations (common/__init__ catches the
+ImportError).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_SRC = Path(__file__).resolve().parents[2] / "csrc" / "magi_host.cpp"
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get(
+        "MAGI_ATTENTION_JIT_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "magiattention_tpu"),
+    )
+    return Path(base)
+
+
+def _build(src: Path, out: Path) -> None:
+    out.parent.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(".so.tmp")
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", str(tmp), str(src),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    tmp.replace(out)
+
+
+def get_lib() -> ctypes.CDLL:
+    """Build (once, cached by source hash) and load the native library."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if not _SRC.exists():
+            raise ImportError(f"native source missing: {_SRC}")
+        digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+        so = _cache_dir() / f"magi_host_{digest}.so"
+        if not so.exists():
+            try:
+                _build(_SRC, so)
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                raise ImportError(f"native build failed: {e}") from e
+        lib = ctypes.CDLL(str(so))
+        _declare(lib)
+        _LIB = lib
+        return lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i64, i32p, i64p = ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)
+    lib.magi_band_area.restype = i64
+    lib.magi_band_area.argtypes = [i64] * 6
+    lib.magi_chunk_areas.restype = None
+    lib.magi_chunk_areas.argtypes = [i64p, i64, i64, i64, i64p]
+    lib.magi_ranges_merge.restype = i64
+    lib.magi_ranges_merge.argtypes = [i32p, i64, i32p]
+    lib.magi_ranges_holes.restype = i64
+    lib.magi_ranges_holes.argtypes = [i32p, i64, i32p, i64, i32p]
+    lib.magi_ranges_overlap.restype = i64
+    lib.magi_ranges_overlap.argtypes = [i32p, i64, i32p, i64, i32p]
+    lib.magi_ranges_make_local.restype = i64
+    lib.magi_ranges_make_local.argtypes = [i32p, i64, i32p, i64, i32p]
+    lib.magi_minheap_solve.restype = None
+    lib.magi_minheap_solve.argtypes = [i64p, i64, i64, i64, i32p]

@@ -1,0 +1,88 @@
+"""Numpy-facing wrappers over the native hot loops."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .build import get_lib
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def band_area_native(i0, i1, j0, j1, lo, hi) -> int:
+    return int(get_lib().magi_band_area(i0, i1, j0, j1, lo, hi))
+
+
+def chunk_areas_native(
+    slices: np.ndarray, chunk_size: int, num_chunks: int
+) -> np.ndarray:
+    """slices: (n, 6) int64 (qs, qe, ks, ke, lo, hi) -> (num_chunks,) areas."""
+    s = np.ascontiguousarray(slices, dtype=np.int64)
+    out = np.zeros(num_chunks, dtype=np.int64)
+    get_lib().magi_chunk_areas(
+        _i64p(s), len(s), chunk_size, num_chunks, _i64p(out)
+    )
+    return out
+
+
+def minheap_solve_native(
+    areas: np.ndarray, cp_size: int, per_rank: int
+) -> list[list[int]]:
+    a = np.ascontiguousarray(areas, dtype=np.int64)
+    assign = np.zeros(len(a), dtype=np.int32)
+    get_lib().magi_minheap_solve(
+        _i64p(a), len(a), cp_size, per_rank, _i32p(assign)
+    )
+    return [np.nonzero(assign == r)[0].tolist() for r in range(cp_size)]
+
+
+def ranges_merge_native(ranges: np.ndarray) -> np.ndarray:
+    r = np.ascontiguousarray(ranges, dtype=np.int32).reshape(-1, 2)
+    out = np.empty_like(r)
+    m = get_lib().magi_ranges_merge(_i32p(r), len(r), _i32p(out))
+    return out[:m].copy()
+
+
+def ranges_holes_native(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Both inputs must be merged."""
+    a = np.ascontiguousarray(a, dtype=np.int32).reshape(-1, 2)
+    b = np.ascontiguousarray(b, dtype=np.int32).reshape(-1, 2)
+    out = np.empty((len(a) + len(b) + 1, 2), dtype=np.int32)
+    m = get_lib().magi_ranges_holes(
+        _i32p(a), len(a), _i32p(b), len(b), _i32p(out)
+    )
+    return out[:m].copy()
+
+
+def ranges_overlap_native(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Both inputs must be merged."""
+    a = np.ascontiguousarray(a, dtype=np.int32).reshape(-1, 2)
+    b = np.ascontiguousarray(b, dtype=np.int32).reshape(-1, 2)
+    out = np.empty((len(a) + len(b) + 1, 2), dtype=np.int32)
+    m = get_lib().magi_ranges_overlap(
+        _i32p(a), len(a), _i32p(b), len(b), _i32p(out)
+    )
+    return out[:m].copy()
+
+
+def ranges_make_local_native(host: np.ndarray, ranges: np.ndarray) -> np.ndarray:
+    """host must be merged; raises if a range is not covered."""
+    h = np.ascontiguousarray(host, dtype=np.int32).reshape(-1, 2)
+    r = np.ascontiguousarray(ranges, dtype=np.int32).reshape(-1, 2)
+    out = np.empty(((len(r) + 1) * (len(h) + 1), 2), dtype=np.int32)
+    m = get_lib().magi_ranges_make_local(
+        _i32p(h), len(h), _i32p(r), len(r), _i32p(out)
+    )
+    if m < 0:
+        from ..common.range import RangeError
+
+        raise RangeError("range not fully covered by host ranges")
+    return out[:m].copy()

@@ -7,7 +7,8 @@ DispatchMeta with permutation indices.
 
 from __future__ import annotations
 
-from ..common.enum import AttnMaskType, AttnType
+from .. import env as _env
+from ..common.enum import AttnMaskType, AttnType, DispatchAlgType
 from ..common.range import AttnRange
 from ..common.ranges import AttnRanges
 from .collection.dispatch_meta import DispatchMeta
@@ -89,8 +90,30 @@ def make_dispatch_meta_from_qk_ranges(
     if cp_size == 1:
         partitions = [list(range(num_chunks))]
     else:
-        solver = DispatchSolver(alg=dispatch_config.alg, config=dispatch_config)
-        partitions = solver.solve(areas, cp_size).partitions
+        partitions = None
+        if (
+            dispatch_config.alg == DispatchAlgType.MIN_HEAP
+            and _env.general.is_cpp_backend_enable()
+        ):
+            try:  # native hot loop (csrc/magi_host.cpp magi_minheap_solve)
+                from ..csrc_backend.ops import minheap_solve_native
+                import numpy as _np
+
+                partitions = [
+                    sorted(p)
+                    for p in minheap_solve_native(
+                        _np.asarray(areas, dtype=_np.int64),
+                        cp_size,
+                        num_chunks // cp_size,
+                    )
+                ]
+            except ImportError:
+                partitions = None
+        if partitions is None:
+            solver = DispatchSolver(
+                alg=dispatch_config.alg, config=dispatch_config
+            )
+            partitions = solver.solve(areas, cp_size).partitions
 
     meta_q = DispatchMeta(
         attn_type=AttnType.SELF_ATTN,

@@ -20,13 +20,40 @@ def band_area(
     i0: int, i1: int, j0: int, j1: int, lo: int, hi: int
 ) -> int:
     """Unmasked pairs of band [lo, hi] on rect [i0,i1) x [j0,j1) — O(rows)
-    vectorized (the C++ backend provides the closed-form hot loop)."""
+    vectorized (the C++ backend provides the closed-form O(1) hot loop,
+    csrc/magi_host.cpp magi_band_area)."""
     if i0 >= i1 or j0 >= j1 or lo > hi:
         return 0
     rows = np.arange(i0, i1, dtype=np.int64)
     lo_j = np.maximum(j0, rows + lo)
     hi_j = np.minimum(j1 - 1, rows + hi)
     return int(np.clip(hi_j - lo_j + 1, 0, None).sum())
+
+
+def _try_enable_native_band_area() -> None:
+    """Swap in the closed-form native band_area when the C++ backend builds."""
+    global band_area
+    from ... import env as _env
+
+    if not _env.general.is_cpp_backend_enable():
+        return
+    try:
+        from ...csrc_backend.ops import band_area_native
+    except ImportError:
+        return
+
+    _py_band_area = band_area
+
+    def band_area(i0, i1, j0, j1, lo, hi):  # noqa: F811
+        if i0 >= i1 or j0 >= j1 or lo > hi:
+            return 0
+        return band_area_native(i0, i1, j0, j1, lo, hi)
+
+    globals()["band_area"] = band_area
+    globals()["_py_band_area"] = _py_band_area
+
+
+_try_enable_native_band_area()
 
 
 def type_to_band(

@@ -1,0 +1,112 @@
+"""Python and C++ backends must implement identical semantics
+(ref: tests/test_common/test_protocol_conformance.py)."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common import protocols
+from magiattention_tpu.common.range import AttnRange as PyRange
+from magiattention_tpu.common.ranges import AttnRanges as PyRanges
+
+cpp = pytest.importorskip("magiattention_tpu.csrc_backend")
+from magiattention_tpu.csrc_backend import CppAttnRange, CppAttnRanges
+from magiattention_tpu.csrc_backend.ops import (
+    band_area_native,
+    chunk_areas_native,
+    minheap_solve_native,
+)
+from magiattention_tpu.meta.container.slice import band_area
+
+
+def random_ranges(rng, n, lim=200):
+    out = []
+    for _ in range(n):
+        a = int(rng.integers(0, lim))
+        b = int(rng.integers(a, lim + 1))
+        out.append((a, b))
+    return out
+
+
+def test_protocol_isinstance():
+    assert isinstance(PyRange(0, 4), protocols.AttnRangeProtocol)
+    assert isinstance(CppAttnRange(0, 4), protocols.AttnRangeProtocol)
+    assert isinstance(PyRanges(), protocols.AttnRangesProtocol)
+    assert isinstance(CppAttnRanges(), protocols.AttnRangesProtocol)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_set_algebra_matches_python(seed):
+    rng = np.random.default_rng(seed)
+    a_raw = random_ranges(rng, int(rng.integers(0, 10)))
+    b_raw = random_ranges(rng, int(rng.integers(0, 10)))
+    pa, pb = PyRanges.from_ranges(a_raw), PyRanges.from_ranges(b_raw)
+    ca, cb = CppAttnRanges.from_ranges(a_raw), CppAttnRanges.from_ranges(b_raw)
+
+    assert pa.merge().to_naive_ranges() == ca.merge().to_naive_ranges()
+    assert (
+        pa.find_hole_ranges(pb).to_naive_ranges()
+        == ca.find_hole_ranges(cb).to_naive_ranges()
+    )
+    assert (
+        pa.find_overlap_ranges(pb).to_naive_ranges()
+        == ca.find_overlap_ranges(cb).to_naive_ranges()
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_make_local_matches_python(seed):
+    rng = np.random.default_rng(100 + seed)
+    host_raw = PyRanges.from_ranges(random_ranges(rng, 4)).merge()
+    if len(host_raw) == 0:
+        return
+    # pick sub-ranges inside the host coverage
+    subs = []
+    for r in host_raw:
+        if r.seqlen >= 2:
+            subs.append((r.start, r.start + r.seqlen // 2))
+    if not subs:
+        return
+    p = host_raw.make_ranges_local(PyRanges.from_ranges(subs))
+    c = CppAttnRanges.from_ranges(host_raw.to_naive_ranges()).make_ranges_local(
+        CppAttnRanges.from_ranges(subs)
+    )
+    assert p.to_naive_ranges() == c.to_naive_ranges()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_band_area_matches_python(seed):
+    rng = np.random.default_rng(seed)
+    i0, i1 = sorted(rng.integers(0, 100, 2).tolist())
+    j0, j1 = sorted(rng.integers(0, 100, 2).tolist())
+    lo = int(rng.integers(-120, 120))
+    hi = lo + int(rng.integers(0, 150))
+    assert band_area_native(i0, i1, j0, j1, lo, hi) == band_area(
+        i0, i1, j0, j1, lo, hi
+    )
+
+
+def test_chunk_areas_matches_python():
+    rng = np.random.default_rng(0)
+    slices = []
+    for _ in range(10):
+        qs, qe = sorted(rng.integers(0, 256, 2).tolist())
+        ks, ke = sorted(rng.integers(0, 256, 2).tolist())
+        slices.append((qs, qe, ks, ke, -(1 << 30), int(rng.integers(-50, 200))))
+    arr = np.asarray(slices, dtype=np.int64)
+    native = chunk_areas_native(arr, 32, 8)
+    expected = np.zeros(8, dtype=np.int64)
+    for qs, qe, ks, ke, lo, hi in slices:
+        for c in range(8):
+            i0, i1 = max(qs, c * 32), min(qe, (c + 1) * 32)
+            expected[c] += band_area(i0, i1, ks, ke, lo, hi)
+    np.testing.assert_array_equal(native, expected)
+
+
+def test_minheap_solve_balances():
+    rng = np.random.default_rng(0)
+    areas = rng.integers(1, 1000, 32)
+    parts = minheap_solve_native(areas, 4, 8)
+    assert sorted(sum(parts, [])) == list(range(32))
+    loads = [sum(int(areas[i]) for i in p) for p in parts]
+    lb = max(areas.sum() / 4, areas.max())
+    assert max(loads) <= lb * 1.3
