@@ -17,5 +17,6 @@ from .magi_attn_interface import (  # noqa: F401
     get_position_ids,
     magi_attn_flex_key,
     magi_attn_varlen_key,
+    roll,
     undispatch,
 )

@@ -164,6 +164,13 @@ def calc_attn(
     return out, AttnForwardMeta(lse=lse)
 
 
+def roll(
+    x: jax.Array, key: DistAttnRuntimeKey, shifts: int = 1
+) -> jax.Array:
+    """Global roll on dispatched tensors (for MTP label shift, ref :965)."""
+    return _mgr(key).roll(x, shifts)
+
+
 def get_position_ids(key: DistAttnRuntimeKey) -> jax.Array:
     """Global position of each dispatched row (for RoPE etc., ref :1117)."""
     return _mgr(key).get_position_ids()

@@ -117,6 +117,13 @@ class DistAttnRuntimeMgr:
     ) -> tuple[jax.Array, jax.Array]:
         return self.runtime.calc_attn(q, k, v)
 
+    def roll(self, x: jax.Array, shifts: int) -> jax.Array:
+        from .functional.roll import roll_func
+
+        return roll_func(
+            x, self.dispatch_meta_q, shifts, self.mesh, self.key.cp_axis
+        )
+
     def get_position_ids(self) -> jax.Array:
         import jax.numpy as jnp
 

@@ -1,0 +1,44 @@
+"""Distributed roll over the dispatched layout (ref: magi_attention/functional/roll.py).
+
+``torch.roll`` on the global sequence while tensors live in the dispatched
+(chunk-permuted, cp-sharded) layout — used for multi-token-prediction label
+shifting. The reference implements this with batched P2P (roll_p2p :448);
+on TPU the rolled permutation composes with the dispatch permutation into a
+single static gather, and XLA lowers the cross-shard rows to collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..meta.collection.dispatch_meta import DispatchMeta
+
+
+def roll_index(meta: DispatchMeta, shifts: int) -> np.ndarray:
+    """Gather index implementing a global roll on dispatched tensors.
+
+    out_disp[flat_pos] = in_disp[idx[flat_pos]] where out corresponds to the
+    globally-rolled sequence re-dispatched with the same permutation.
+    """
+    pos = meta.position_ids.reshape(-1)  # local row -> global row
+    unperm = meta.unpermute_index  # global row -> local row
+    src_global = (pos - shifts) % meta.total_seqlen
+    return unperm[src_global].astype(np.int32)
+
+
+def roll_func(
+    x: jax.Array,
+    meta: DispatchMeta,
+    shifts: int,
+    mesh: Mesh,
+    cp_axis: str,
+) -> jax.Array:
+    """Roll the dispatched tensor by ``shifts`` global positions."""
+    idx = jnp.asarray(roll_index(meta, shifts))
+    y = jnp.take(x, idx, axis=0)
+    return jax.lax.with_sharding_constraint(
+        y, NamedSharding(mesh, P(cp_axis, *([None] * (x.ndim - 1))))
+    )

@@ -35,7 +35,7 @@ from ..collection.calc_meta import AttnArg, CalcMeta
 from ..collection.comm_meta import CommMeta, GroupCollectiveArg
 from ..collection.dispatch_meta import DispatchMeta
 from ..container.bucket import AttnBucket
-from ..container.slice import AttnSlice
+from ..container.slice import AttnSlice, band_area
 
 
 def _round_up(x: int, m: int) -> int:
@@ -50,6 +50,7 @@ class _RemoteInterval:
     grange: AttnRange  # global coords
     stage: int = 0
     offset: int = 0  # local offset within its stage's receive buffer
+    area: int = 0  # attention area computed against these rows
 
 
 class DistAttnSolver:
@@ -110,8 +111,21 @@ class DistAttnSolver:
             for src in range(cp):
                 for g in requests[r][src].merge():
                     intervals[r].append(_RemoteInterval(src=src, grange=g))
+            # per-interval calc cost for the overlap solver
+            for q_loc, k_glob, lo, hi, qoff in deferred[r]:
+                iv = _find_interval(intervals[r], k_glob)
+                iv.area += band_area(
+                    q_loc.start + qoff, q_loc.end + qoff,
+                    k_glob.start, k_glob.end, lo, hi,
+                )
 
         self._assign_stages(intervals, degree)
+        # dynamic mode (degree=None) may pick any degree per rank: size the
+        # stage tables to the max assigned stage
+        degree = max(
+            [degree]
+            + [iv.stage + 1 for ivs in intervals for iv in ivs]
+        )
 
         rank_stage_len: list[list[int]] = [[0] * degree for _ in range(cp)]
         for r in range(cp):
@@ -257,23 +271,25 @@ class DistAttnSolver:
                     requests_out[src].append(part)
                     deferred_out.append((q_loc, part, lo, hi, qoff))
 
-    @staticmethod
     def _assign_stages(
-        intervals: list[list[_RemoteInterval]], degree: int
+        self, intervals: list[list[_RemoteInterval]], degree: int
     ) -> None:
-        """Greedy balanced grouping of each rank's intervals into stages
-        (ref solver/overlap_solver.py UniformOverlapAlg)."""
-        if degree == 1:
+        """Group each rank's intervals into overlap stages via OverlapSolver
+        (uniform / greedy / dynamic-degree per overlap_config)."""
+        if degree == 1 and self.overlap_config.degree is not None:
             return
+        from .overlap_solver import OverlapItem, OverlapSolver
+
+        solver = OverlapSolver(self.overlap_config)
         for ivs in intervals:
-            total = sum(iv.grange.seqlen for iv in ivs)
-            target = -(-total // degree) if total else 1
-            st, acc = 0, 0
-            for iv in ivs:
-                iv.stage = min(st, degree - 1)
-                acc += iv.grange.seqlen
-                if acc >= target * (st + 1) and st < degree - 1:
-                    st += 1
+            if not ivs:
+                continue
+            items = [
+                OverlapItem(rows=iv.grange.seqlen, area=iv.area) for iv in ivs
+            ]
+            assign, _ = solver.solve(items)
+            for iv, st in zip(ivs, assign):
+                iv.stage = st
 
     def _make_group_collective_arg(
         self,

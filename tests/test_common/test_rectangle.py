@@ -1,0 +1,64 @@
+"""AttnRectangle geometry tests."""
+
+import numpy as np
+
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import slice_mask_block
+from magiattention_tpu.common.range import AttnRange
+from magiattention_tpu.common.rectangle import AttnRectangle, AttnRectangles
+
+
+def brute_area(r: AttnRectangle) -> int:
+    total = 0
+    for i in range(r.q_range.start, r.q_range.end):
+        for j in range(r.k_range.start, r.k_range.end):
+            if r.d_lo <= j - i <= r.d_hi:
+                total += 1
+    return total
+
+
+def test_from_mask_type_matches_slice_mask():
+    qr, kr = AttnRange(3, 19), AttnRange(1, 25)
+    for mt in AttnMaskType:
+        rect = AttnRectangle.from_mask_type(qr, kr, mt)
+        assert rect.area() == int(slice_mask_block(qr, kr, mt).sum())
+
+
+def test_cut_q_preserves_area():
+    rect = AttnRectangle.from_mask_type(
+        AttnRange(0, 32), AttnRange(0, 32), AttnMaskType.CAUSAL
+    )
+    for pos in [0, 7, 16, 32]:
+        top, bot = rect.cut_q(pos)
+        assert top.area() + bot.area() == rect.area()
+
+
+def test_cut_k_preserves_area():
+    rect = AttnRectangle.from_mask_type(
+        AttnRange(0, 32), AttnRange(0, 48), AttnMaskType.BICAUSAL
+    )
+    for pos in [0, 13, 24, 48]:
+        left, right = rect.cut_k(pos)
+        assert left.area() + right.area() == rect.area()
+
+
+def test_shrink_tightens():
+    # causal over a tall box: top-right is all masked
+    rect = AttnRectangle(AttnRange(0, 64), AttnRange(0, 16), -1 << 30, 16 - 64)
+    s = rect.shrink()
+    assert s.area() == rect.area() == brute_area(rect)
+    assert s.q_range.seqlen <= rect.q_range.seqlen
+    assert s.k_range.seqlen <= rect.k_range.seqlen
+
+
+def test_rectangles_bulk():
+    from magiattention_tpu.common.ranges import AttnRanges
+
+    q = AttnRanges.from_ranges([(0, 16), (16, 64)])
+    k = AttnRanges.from_ranges([(0, 16), (0, 64)])
+    rects = AttnRectangles.from_ranges(
+        q, k, [AttnMaskType.CAUSAL, AttnMaskType.CAUSAL]
+    )
+    total = rects.area()
+    top, bot = rects.cut_q(32)
+    assert top.area() + bot.area() == total

@@ -1,0 +1,24 @@
+"""Distributed roll tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu.api import dispatch, magi_attn_flex_key, roll, undispatch
+
+S = 128
+
+
+@pytest.mark.parametrize("shifts", [1, -1, 5, -17])
+def test_roll_matches_global(shifts):
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), axis_names=("cp",))
+    key = magi_attn_flex_key(
+        [[0, S]], [[0, S]], [1], S, S, mesh=mesh, chunk_size=16
+    )
+    x = jnp.arange(S, dtype=jnp.float32)[:, None] * jnp.ones((1, 3))
+    x_d = dispatch(x, key)
+    rolled = undispatch(roll(x_d, key, shifts), key)
+    expected = jnp.roll(x, shifts, axis=0)
+    np.testing.assert_array_equal(np.asarray(rolled), np.asarray(expected))

@@ -170,3 +170,14 @@ def test_dispatch_balance():
     assert max(loads) <= lb * 1.25
     # every rank has exactly num_chunks / cp chunks
     assert all(len(p) == len(areas) // 4 for p in meta_q.partitions)
+
+
+def test_dynamic_overlap_degree():
+    # degree=None -> OverlapSolver sweeps degrees; plans must stay exact
+    from magiattention_tpu.common.enum import AttnOverlapMode
+
+    recon, expected, comm_meta, calc_meta, _ = reconstruct_global_mask(
+        "causal", 4, overlap_degree=None
+    )
+    assert (recon == expected).all()
+    assert comm_meta.overlap_degree >= 1
