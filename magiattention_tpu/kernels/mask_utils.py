@@ -34,19 +34,19 @@ def types_to_bands(q_ranges, k_ranges, attn_type_map):
     Returns:
         (d_lo, d_hi) int32 arrays of shape (N,).
     """
+    import numpy as np
+
     t = attn_type_map
     is_causal = (t == 1) | (t == 3)
     is_inv = (t == 2) | (t == 3)
     hi_bound = k_ranges[:, 1] - q_ranges[:, 1]
     lo_bound = k_ranges[:, 0] - q_ranges[:, 0]
-    if hasattr(t, "device"):  # jnp
-        d_hi = jnp.where(is_causal, hi_bound, BAND_INF).astype(jnp.int32)
-        d_lo = jnp.where(is_inv, lo_bound, -BAND_INF).astype(jnp.int32)
-    else:
-        import numpy as np
-
+    if isinstance(t, np.ndarray):
         d_hi = np.where(is_causal, hi_bound, BAND_INF).astype(np.int32)
         d_lo = np.where(is_inv, lo_bound, -BAND_INF).astype(np.int32)
+    else:
+        d_hi = jnp.where(is_causal, hi_bound, BAND_INF).astype(jnp.int32)
+        d_lo = jnp.where(is_inv, lo_bound, -BAND_INF).astype(jnp.int32)
     return d_lo, d_hi
 
 

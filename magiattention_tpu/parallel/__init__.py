@@ -1,0 +1,10 @@
+"""Parallelism strategies beyond the flagship CP engine.
+
+Ref: exps/dist_attn/baselines/ — the reference ships Ulysses / Ring /
+USP / LoongTrain context-parallel baselines for its distributed benchmark
+comparison; these are the TPU-native equivalents built on the same FFA
+kernel and XLA collectives.
+"""
+
+from .ulysses import ulysses_attn  # noqa: F401
+from .ring import ring_attn  # noqa: F401
