@@ -16,8 +16,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.forward_meta import AttnForwardMeta
+from functools import partial
+
 from ..env import general as env_general
-from .. import env as _env
 
 
 def _as_range_array(ranges: Any, name: str) -> jax.Array:
@@ -94,14 +95,27 @@ def flex_flash_attn_func(
             compute_dtype=compute_dtype,
         )
     elif backend == "ffa":
-        from ..kernels.ffa import ffa_attn
+        if sink is not None:
+            out, lse = _ffa_with_sink(
+                q, k, v, sink, qr, kr, tmap,
+                softmax_scale=softmax_scale, softcap=softcap,
+            )
+        else:
+            from ..kernels.ffa import ffa_attn
 
-        out, lse = ffa_attn(
-            q, k, v, qr, kr, tmap,
-            softmax_scale=softmax_scale, softcap=softcap,
-        )
+            out, lse = ffa_attn(
+                q, k, v, qr, kr, tmap,
+                softmax_scale=softmax_scale, softcap=softcap,
+            )
     else:
         raise ValueError(f"unknown kernel backend: {backend}")
+
+    if sink is not None and backend in ("sdpa", "sdpa_online"):
+        # jnp backends are differentiated end-to-end by jax AD, so folding
+        # the sink in afterwards is gradient-exact automatically
+        from .sink import apply_sink_fwd
+
+        out, lse = apply_sink_fwd(out, lse, sink)
 
     meta = AttnForwardMeta(lse=lse)
     if return_max_logits:
@@ -109,3 +123,111 @@ def flex_flash_attn_func(
         # via the sdpa path only when explicitly requested (testing aid).
         meta.max_logits = jnp.max(lse, axis=0)
     return out, meta
+
+
+# ---------------------------------------------------------------------------
+# ffa + sink (custom VJP: kernel backward against the sink-adjusted lse)
+# ---------------------------------------------------------------------------
+
+
+def _ffa_with_sink(
+    q, k, v, sink, qr, kr, tmap, *, softmax_scale, softcap
+):
+    from functools import partial as _partial
+
+    from ..kernels.ffa import (
+        FFAParams,
+        _should_interpret,
+        default_blocks,
+        get_ffa_plan,
+        plan_arrays,
+    )
+    from ..kernels.mask_utils import types_to_bands
+
+    qr_np = np.asarray(qr, dtype=np.int32)
+    kr_np = np.asarray(kr, dtype=np.int32)
+    tm_np = np.asarray(tmap, dtype=np.int32)
+    d_lo, d_hi = types_to_bands(qr_np, kr_np, tm_np)
+    sq, hq, d = q.shape
+    sk, hk, dv = v.shape
+    scale = float(d) ** -0.5 if softmax_scale is None else float(softmax_scale)
+    bq, bk = default_blocks(sq, sk)
+    plan = get_ffa_plan(qr_np, kr_np, d_lo, d_hi, sq, sk, bq, bk)
+    params = FFAParams(
+        num_work=plan.num_work, num_work_t=plan.num_work_t,
+        num_q_tiles=plan.num_q_tiles, num_k_tiles=plan.num_k_tiles,
+        block_q=bq, block_k=bk, softmax_scale=scale,
+        softcap=float(softcap), group=hq // hk,
+        interpret=_should_interpret(),
+    )
+    return _ffa_sink_core(q, k, v, sink, plan_arrays(plan), params)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _ffa_sink_core(q, k, v, sink, arrays, params):
+    out, lse = _ffa_sink_fwd_impl(q, k, v, sink, arrays, params)
+    return out, lse
+
+
+def _ffa_sink_fwd_impl(q, k, v, sink, arrays, params):
+    from ..kernels.ffa import _ffa_fwd_pallas
+    from .dist_attn import _head_major
+    from .sink import apply_sink_fwd
+
+    sqp = params.num_q_tiles * params.block_q
+    skp = params.num_k_tiles * params.block_k
+    out_t, lse_t = _ffa_fwd_pallas(
+        params, *arrays[:3],
+        _head_major(q, sqp), _head_major(k, skp), _head_major(v, skp),
+    )
+    out = out_t.transpose(1, 0, 2)[: q.shape[0]]
+    lse = lse_t.T[: q.shape[0]]
+    return apply_sink_fwd(out, lse, sink)
+
+
+def _ffa_sink_core_fwd(q, k, v, sink, arrays, params):
+    out, lse = _ffa_sink_fwd_impl(q, k, v, sink, arrays, params)
+    return (out, lse), (q, k, v, sink, out, lse, arrays)
+
+
+def _ffa_sink_core_bwd(params, res, cts):
+    from ..kernels.ffa import _ffa_bwd_dkv_pallas, _ffa_bwd_dq_pallas
+    from .dist_attn import _head_major
+    from .sink import sink_bwd
+
+    do, _ = cts
+    q, k, v, sink, out, lse, arrays = res
+    sq = q.shape[0]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    sqp = params.num_q_tiles * params.block_q
+    skp = params.num_k_tiles * params.block_k
+    q_t, k_t, v_t = (
+        _head_major(q, sqp), _head_major(k, skp), _head_major(v, skp)
+    )
+    do_t = _head_major(do, sqp)
+    lse_t = jnp.pad(
+        lse, ((0, sqp - sq), (0, 0)), constant_values=float("-inf")
+    ).T
+    delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
+    dq_t = _ffa_bwd_dq_pallas(
+        params, *arrays[:3], q_t, k_t, v_t, do_t, lse_t, delta_t
+    )
+    dk_t, dv_t = _ffa_bwd_dkv_pallas(
+        params, *arrays[3:6], q_t, k_t, v_t, do_t, lse_t, delta_t
+    )
+    g = params.group
+    if g > 1:
+        hq, skp_, dh = dk_t.shape
+        dk_t = dk_t.reshape(hq // g, g, skp_, dh).sum(axis=1)
+        dv_t = dv_t.reshape(hq // g, g, skp_, dv_t.shape[-1]).sum(axis=1)
+    dsink = sink_bwd(sink, lse, delta)
+    return (
+        dq_t.transpose(1, 0, 2)[:sq].astype(q.dtype),
+        dk_t.transpose(1, 0, 2)[: k.shape[0]].astype(k.dtype),
+        dv_t.transpose(1, 0, 2)[: v.shape[0]].astype(v.dtype),
+        dsink,
+        None,
+    )
+
+
+_ffa_sink_core.defvjp(_ffa_sink_core_fwd, _ffa_sink_core_bwd)

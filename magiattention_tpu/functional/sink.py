@@ -1,0 +1,55 @@
+"""Attention-sink support (ref: extensions/fa{2,3,4}_interface_with_sink.py,
+ref_attn.py init_lse_with_sink).
+
+Sink tokens contribute learnable logits to every query row's softmax
+normalization but no value vectors: with per-token-per-head sink logits
+``sink (s_sink, h)``,
+
+    lse' = logaddexp(lse, logsumexp_j sink[j])       (per row, per head)
+    out' = out * exp(lse - lse')
+
+Gradients use the same final-lse identity as the distributed merge: the
+kernel backward runs against lse', which renormalizes dq/dk/dv exactly, and
+    dsink[j, h] = -sum_i exp(sink[j,h] - lse'[i,h]) * delta[i,h]
+with delta = rowsum(do * out').
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def apply_sink_fwd(
+    out: jax.Array, lse: jax.Array, sink: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(out, lse) without sink -> (out', lse') with sink folded in.
+
+    Args:
+        out: ``(s, h, dv)``; lse: ``(s, h)`` fp32; sink: ``(s_sink, h)``.
+    """
+    sink_lse = jax.scipy.special.logsumexp(
+        sink.astype(jnp.float32), axis=0
+    )  # (h,)
+    neg = jnp.isneginf(lse)
+    lse_new = jnp.logaddexp(jnp.where(neg, -jnp.inf, lse), sink_lse[None, :])
+    w = jnp.exp(jnp.where(neg, -jnp.inf, lse - jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)))
+    out_new = (out.astype(jnp.float32) * w[..., None]).astype(out.dtype)
+    return out_new, lse_new
+
+
+def sink_bwd(
+    sink: jax.Array, lse_final: jax.Array, delta: jax.Array
+) -> jax.Array:
+    """dsink from the final lse and delta (ref functional/utils.py sink_bwd).
+
+    Args:
+        sink: ``(s_sink, h)``; lse_final: ``(s, h)``; delta: ``(s, h)`` =
+            rowsum(do * out_final), fp32.
+    """
+    # p_sink[i, j, h] = exp(sink[j,h] - lse'[i,h])
+    w = jnp.exp(
+        sink.astype(jnp.float32)[None, :, :]
+        - jnp.where(jnp.isneginf(lse_final), jnp.inf, lse_final)[:, None, :]
+    )  # rows with -inf lse' have no mass anywhere -> w = 0
+    return (-jnp.einsum("ijh,ih->jh", w, delta)).astype(sink.dtype)

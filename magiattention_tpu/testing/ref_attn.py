@@ -21,6 +21,7 @@ def ref_attn(
     mask: np.ndarray,
     softmax_scale: float | None = None,
     softcap: float = 0.0,
+    sink=None,
     compute_dtype=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Dense reference attention.
@@ -28,6 +29,8 @@ def ref_attn(
     Args:
         q/k/v: ``[sq,hq,d] / [sk,hk,d] / [sk,hk,dv]`` (varlen packed layout).
         mask: ``[sq, sk]`` boolean numpy array (True = attend).
+        sink: optional ``(s_sink, hq)`` learnable sink logits — extra softmax
+            columns with no value contribution.
 
     Returns:
         (out ``[sq,hq,dv]`` in q.dtype, lse ``[sq,hq]`` fp32).
@@ -51,9 +54,24 @@ def ref_attn(
     if softcap > 0.0:
         logits = softcap * jnp.tanh(logits / softcap)
     logits = jnp.where(maskj[None], logits, NEG_INF)
+    maskj_h = jnp.broadcast_to(maskj[None], logits.shape)
+    if sink is not None:
+        # append sink columns: participate in softmax, contribute no value
+        s_sink = sink.shape[0]
+        sink_cols = jnp.broadcast_to(
+            jnp.asarray(sink, dtype=compute_dtype).T[:, None, :],
+            (hq, sq, s_sink),
+        )
+        logits = jnp.concatenate([logits, sink_cols], axis=-1)
+        maskj_h = jnp.concatenate(
+            [maskj_h, jnp.ones((hq, sq, s_sink), dtype=bool)], axis=-1
+        )
+        vc = jnp.concatenate(
+            [vc, jnp.zeros((s_sink, hq, dv), dtype=compute_dtype)], axis=0
+        )
 
     lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [hq, sq]
     p = jnp.exp(logits - jnp.where(jnp.isfinite(lse), lse, 0.0)[..., None])
-    p = jnp.where(maskj[None], p, 0.0)
+    p = jnp.where(maskj_h, p, 0.0)
     out = jnp.einsum("hqk,khd->qhd", p, vc)
     return out.astype(q.dtype), lse.T.astype(jnp.float32)

@@ -1,0 +1,67 @@
+"""Attention-sink correctness: fwd and gradients (incl. dsink) vs reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.functional.flex_flash_attn import flex_flash_attn_func
+from magiattention_tpu.testing import assert_close, ref_attn
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.common.enum import AttnMaskType
+
+S, HQ, HK, D = 128, 4, 2, 32
+S_SINK = 2
+
+
+def setup(seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=jnp.float32)
+    sink = jnp.asarray(rng.standard_normal((S_SINK, HQ)), dtype=jnp.float32)
+    qr, kr, tm = np.array([[0, S]]), np.array([[0, S]]), np.array([1])
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr.tolist()),
+        AttnRanges.from_ranges(kr.tolist()),
+        [AttnMaskType.CAUSAL],
+        total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+    return q, k, v, sink, qr, kr, tm, mask
+
+
+@pytest.mark.parametrize("backend", ["sdpa", "sdpa_online", "ffa"])
+def test_sink_forward(backend):
+    q, k, v, sink, qr, kr, tm, mask = setup()
+    out, meta = flex_flash_attn_func(
+        q, k, v, qr, kr, tm, sink=sink, backend=backend
+    )
+    out_ref, lse_ref = ref_attn(q, k, v, mask, sink=sink, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=2e-5,
+                 msg=f"{backend} sink out")
+    assert_close(meta.lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=2e-5,
+                 msg=f"{backend} sink lse")
+
+
+@pytest.mark.parametrize("backend", ["sdpa", "ffa"])
+def test_sink_backward(backend):
+    q, k, v, sink, qr, kr, tm, mask = setup(1)
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=jnp.float32)
+
+    def loss(q, k, v, sink):
+        out, _ = flex_flash_attn_func(
+            q, k, v, qr, kr, tm, sink=sink, backend=backend
+        )
+        return jnp.sum(out * w)
+
+    def loss_ref(q, k, v, sink):
+        out, _ = ref_attn(q, k, v, mask, sink=sink, compute_dtype=jnp.float32)
+        return jnp.sum(out * w)
+
+    g = jax.grad(loss, argnums=(0, 1, 2, 3))(q, k, v, sink)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, sink)
+    for name, a, b in zip("dq dk dv dsink".split(), g, g_ref):
+        assert_close(a, b, atol=1e-3, rtol=1e-3, norm_rtol=3e-4,
+                     msg=f"{backend} {name}")
