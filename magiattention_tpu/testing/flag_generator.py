@@ -1,0 +1,86 @@
+"""Env-flag combination generator (ref: magi_attention/testing/flag_generator.py:25-330).
+
+Iterates valid combinations of behavior-affecting env flags so CI covers the
+flag matrix without exhaustive blowup. Strategies: constant (defaults only),
+sequential (one flag varied at a time), random (seeded sampling), heuristic
+(hand-picked high-risk combos).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from contextlib import contextmanager
+from typing import Iterator
+
+# flag -> candidate values (None = unset)
+FLAG_SPACE: dict[str, list[str | None]] = {
+    "MAGI_ATTENTION_KERNEL_BACKEND": [None, "sdpa", "sdpa_online", "ffa"],
+    "MAGI_ATTENTION_RANGE_MERGE": [None, "0", "1"],
+    "MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE": [None, "0", "1"],
+    "MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE": [None, "0", "1"],
+    "MAGI_ATTENTION_CPP_BACKEND": [None, "0", "1"],
+    "MAGI_ATTENTION_DETERMINISTIC_MODE": [None, "0", "1"],
+}
+
+HEURISTIC_COMBOS: list[dict[str, str]] = [
+    {"MAGI_ATTENTION_KERNEL_BACKEND": "sdpa",
+     "MAGI_ATTENTION_CPP_BACKEND": "0"},
+    {"MAGI_ATTENTION_KERNEL_BACKEND": "ffa",
+     "MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE": "0"},
+    {"MAGI_ATTENTION_KERNEL_BACKEND": "sdpa_online",
+     "MAGI_ATTENTION_DETERMINISTIC_MODE": "1"},
+]
+
+
+class FlagCombGenerator:
+    """Yields flag dicts; apply with :func:`with_flags`."""
+
+    def __init__(self, strategy: str = "heuristic", seed: int = 0,
+                 max_combos: int = 8) -> None:
+        self.strategy = strategy
+        self.seed = seed
+        self.max_combos = max_combos
+
+    def __iter__(self) -> Iterator[dict[str, str | None]]:
+        if self.strategy == "constant":
+            yield {}
+        elif self.strategy == "sequential":
+            yield {}
+            for flag, values in FLAG_SPACE.items():
+                for v in values:
+                    if v is not None:
+                        yield {flag: v}
+        elif self.strategy == "random":
+            rng = random.Random(self.seed)
+            for _ in range(self.max_combos):
+                combo = {}
+                for flag, values in FLAG_SPACE.items():
+                    v = rng.choice(values)
+                    if v is not None:
+                        combo[flag] = v
+                yield combo
+        elif self.strategy == "heuristic":
+            yield {}
+            yield from HEURISTIC_COMBOS
+        else:
+            raise ValueError(f"unknown strategy {self.strategy}")
+
+
+@contextmanager
+def with_flags(combo: dict[str, str | None]):
+    """Temporarily apply a flag combination to os.environ."""
+    saved = {k: os.environ.get(k) for k in combo}
+    try:
+        for k, v in combo.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -1,0 +1,44 @@
+"""Memory budget calculators (ref: magi_attention/utils/mem_budget.py:126-215).
+
+The reference budgets FFA workspace HBM; on TPU the scarce resource is VMEM
+(~16 MB/core): the fwd kernel keeps one q tile, one k tile, one v tile, the
+out tile, and the fp32 accumulators resident. These helpers size tiles and
+bound the maximum merged-buffer seqlen for a given budget.
+"""
+
+from __future__ import annotations
+
+
+def ffa_vmem_budget(
+    block_q: int,
+    block_k: int,
+    head_dim: int,
+    head_dim_v: int | None = None,
+    dtype_bytes: int = 2,
+) -> int:
+    """Approximate fwd-kernel VMEM residency in bytes (per grid step, double
+    buffered by the pipeline)."""
+    dv = head_dim_v or head_dim
+    q = block_q * head_dim * dtype_bytes
+    k = block_k * head_dim * dtype_bytes
+    v = block_k * dv * dtype_bytes
+    out = block_q * dv * dtype_bytes
+    acc = block_q * dv * 4
+    ml = 2 * block_q * 128 * 4
+    s = block_q * block_k * 4  # logits tile (fp32)
+    return 2 * (q + k + v + out) + acc + ml + s
+
+
+def ffa_max_total_seqlen(
+    vmem_bytes: int,
+    block_q: int,
+    block_k: int,
+    head_dim: int,
+    dtype_bytes: int = 2,
+) -> int:
+    """Upper bound on the merged kv length whose *index metadata* fits the
+    scalar-prefetch budget (the payload streams from HBM, so the real bound
+    is plan size, not seqlen)."""
+    per_item = 9 * 4 + 2 * 4  # meta row + two work indices
+    max_items = max(1, vmem_bytes // (8 * per_item))
+    return max_items * block_k

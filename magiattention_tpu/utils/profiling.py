@@ -1,0 +1,63 @@
+"""Tracing / profiling helpers (ref: magi_attention/utils/nvtx.py).
+
+The reference instruments every hot-path function with NVTX ranges and opens
+torch.profiler windows; the TPU equivalents are ``jax.named_scope`` (shows up
+in XLA HLO + xprof traces) and ``jax.profiler`` trace windows.
+"""
+
+from __future__ import annotations
+
+import functools
+from contextlib import contextmanager
+from typing import Callable
+
+import jax
+
+
+def instrument_scope(fn: Callable | None = None, *, name: str | None = None):
+    """Decorator wrapping a function in a ``jax.named_scope`` (the
+    ``instrument_nvtx`` equivalent, ref nvtx.py:81). Scope names appear in
+    HLO metadata and profiler traces."""
+
+    def wrap(f):
+        scope = name or f.__qualname__
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            with jax.named_scope(scope):
+                return f(*args, **kwargs)
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+@contextmanager
+def add_profile_event(name: str):
+    """Annotate a host-side region in the profiler trace (ref add_nvtx_event)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class switch_profile:
+    """Start/stop a jax profiler window (ref nvtx.py:110 switch_profile).
+
+    Usage::
+
+        prof = switch_profile(log_dir="/tmp/trace")
+        prof.start(); ...steps...; prof.stop()
+    """
+
+    def __init__(self, log_dir: str = "/tmp/magiattention_tpu_trace") -> None:
+        self.log_dir = log_dir
+        self._running = False
+
+    def start(self) -> None:
+        if not self._running:
+            jax.profiler.start_trace(self.log_dir)
+            self._running = True
+
+    def stop(self) -> None:
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False

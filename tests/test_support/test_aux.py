@@ -1,0 +1,69 @@
+"""Aux subsystem tests: flag generator, gt dispatcher, bench harness, utils."""
+
+import numpy as np
+
+from magiattention_tpu.benchmarking import Benchmark, do_bench, perf_report
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+from magiattention_tpu.testing.flag_generator import FlagCombGenerator, with_flags
+from magiattention_tpu.testing.gt_dispatcher import GroundTruthDispatcher
+from magiattention_tpu.utils import ffa_vmem_budget, instrument_scope
+
+
+def test_flag_generator_strategies():
+    import os
+
+    combos = list(FlagCombGenerator("heuristic"))
+    assert combos[0] == {}
+    assert len(combos) >= 3
+    combos = list(FlagCombGenerator("random", seed=1, max_combos=4))
+    assert len(combos) == 4
+    with with_flags({"MAGI_ATTENTION_KERNEL_BACKEND": "sdpa"}):
+        assert os.environ["MAGI_ATTENTION_KERNEL_BACKEND"] == "sdpa"
+    assert os.environ.get("MAGI_ATTENTION_KERNEL_BACKEND") != "sdpa"
+
+
+def test_gt_dispatcher_matches_solver_areas():
+    S, CHUNK = 256, 32
+    q = AttnRanges.from_ranges([[0, 96], [96, S]])
+    k = AttnRanges.from_ranges([[0, 96], [0, S]])
+    t = [AttnMaskType.CAUSAL, AttnMaskType.CAUSAL]
+    gt = GroundTruthDispatcher(q, k, t, S)
+    _, _, bucket = make_dispatch_meta_from_qk_ranges(q, k, t, S, S, CHUNK, 4)
+    np.testing.assert_array_equal(
+        gt.chunk_areas(CHUNK), np.asarray(bucket.areas_per_chunk)
+    )
+
+
+def test_do_bench_and_perf_report():
+    import jax.numpy as jnp
+
+    x = jnp.ones((64, 64))
+    ms = do_bench(lambda: x @ x, warmup=1, rep=3)
+    assert ms[0] > 0
+
+    bench = Benchmark(
+        x_names=["n"], x_vals=[32, 64], line_arg="mode",
+        line_vals=["a"], line_names=["a"],
+    )
+
+    @perf_report(bench)
+    def run_one(n, mode):
+        return float(n)
+
+    rows = run_one.run(print_data=False)
+    assert rows[0]["a"] == 32.0
+
+
+def test_instrument_scope():
+    @instrument_scope
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+
+
+def test_vmem_budget_reasonable():
+    b = ffa_vmem_budget(256, 512, 128)
+    assert 0 < b < 16 * 1024 * 1024  # fits one v5e core's VMEM
