@@ -212,6 +212,19 @@ class DistAttnRuntime:
             jnp.asarray(s.recv_sel) for s in cm.kv_stages
         ]  # each (cp, R)
 
+        # merged slice arrays for the jnp (sdpa) backend path: (cp, N, 2)/(cp, N)
+        n_max = max(a.num_slices for a in km.merged_args) or 1
+        padded = [a.pad_to(n_max) for a in km.merged_args]
+        self._merged_slices = tuple(
+            jnp.asarray(np.stack([getattr(a, f) for a in padded]))
+            for f in ("q_ranges", "k_ranges", "d_lo", "d_hi")
+        )
+
+    @property
+    def backend(self) -> str:
+        """Kernel backend (env-driven; part of the runtime cache key)."""
+        return env_general.kernel_backend()
+
     # ------------------------------------------------------------------
 
     def _ffa_params(self, dims, scale, group) -> FFAParams:
@@ -246,6 +259,43 @@ class DistAttnRuntime:
         )
         axis = self.cp_axis
         spec = P(axis)
+
+        if self.backend in ("sdpa", "sdpa_online"):
+            # jnp fake-backend path (fp32/fp64-exact distributed testing,
+            # mirroring the reference's sdpa backend strategy): merged concat
+            # buffer + dense band-mask replay, AD end-to-end
+            from ..kernels.sdpa import sdpa_attn
+            from ..kernels.sdpa_online import sdpa_online_attn
+
+            dense_fn = sdpa_attn if self.backend == "sdpa" else sdpa_online_attn
+            softcap = self.softcap
+
+            def f(q, k, v, send_idxs, recv_sels, slices):
+                parts_k, parts_v = [k], [v]
+                for si, rs in zip(send_idxs, recv_sels):
+                    parts_k.append(group_cast_rows(k, si[0], rs[0], axis))
+                    parts_v.append(group_cast_rows(v, si[0], rs[0], axis))
+                k_all = jnp.concatenate(parts_k, axis=0)
+                v_all = jnp.concatenate(parts_v, axis=0)
+                qr, kr, lo, hi = (a[0] for a in slices)
+                return dense_fn(
+                    q, k_all, v_all, qr, kr, None,
+                    softmax_scale=scale, softcap=softcap,
+                    d_lo=lo, d_hi=hi,
+                )
+
+            fn = shard_map(
+                f,
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec,
+                          [P(axis) for _ in self._send_idx],
+                          [P(axis) for _ in self._recv_sel],
+                          tuple(P(axis) for _ in self._merged_slices)),
+                out_specs=(spec, spec),
+                check_vma=False,
+            )
+            return fn(q, k, v, self._send_idx, self._recv_sel,
+                      self._merged_slices)
 
         if not self.use_overlap:
             params = self._ffa_params(self._merged_dims, scale, group)

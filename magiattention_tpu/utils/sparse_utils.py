@@ -1,0 +1,80 @@
+"""Block/index-sparse mask utilities (ref: magi_attention/utils/sparse_utils.py).
+
+Converts sparse attention patterns into the slice metadata the FFA kernel
+consumes (the reference's block-mask -> ranges conversion :371-407 and
+topk -> ranges :262-304). Covers the Magi-1 spatiotemporal video mask
+(BASELINE config 4): a per-block boolean mask over (q_blocks, k_blocks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..common.ranges import AttnRanges
+
+
+def block_mask_to_ranges(
+    block_mask: np.ndarray,
+    block_size_q: int,
+    block_size_k: int,
+) -> tuple[AttnRanges, AttnRanges, list]:
+    """Block-boolean mask -> (q_ranges, k_ranges, FULL types).
+
+    One slice per maximal contiguous run of attended k blocks in each q-block
+    row (runs collapse many blocks into one wide slice — the kernel's plan
+    stays small for structured video masks).
+    """
+    from ..common.enum import AttnMaskType
+
+    nqb, nkb = block_mask.shape
+    q_out, k_out, t_out = AttnRanges(), AttnRanges(), []
+    from ..common.range import AttnRange
+
+    for qb in range(nqb):
+        row = block_mask[qb]
+        j = 0
+        while j < nkb:
+            if not row[j]:
+                j += 1
+                continue
+            j0 = j
+            while j < nkb and row[j]:
+                j += 1
+            q_out.append(AttnRange(qb * block_size_q, (qb + 1) * block_size_q))
+            k_out.append(AttnRange(j0 * block_size_k, j * block_size_k))
+            t_out.append(AttnMaskType.FULL)
+    return q_out, k_out, t_out
+
+
+def topk_indices_to_block_mask(
+    topk_idx: np.ndarray, num_k_blocks: int
+) -> np.ndarray:
+    """(nqb, topk) block indices (pad -1) -> (nqb, nkb) boolean block mask
+    (the index-sparse -> block-sparse preprocessing, ref :262-304)."""
+    nqb = topk_idx.shape[0]
+    mask = np.zeros((nqb, num_k_blocks), dtype=bool)
+    for qb in range(nqb):
+        for idx in topk_idx[qb]:
+            if idx >= 0:
+                mask[qb, int(idx)] = True
+    return mask
+
+
+def make_video_block_mask(
+    num_frames: int,
+    tokens_per_frame_blocks: int,
+    window_frames: int = 2,
+    causal_frames: bool = True,
+) -> np.ndarray:
+    """Magi-1 style spatiotemporal pattern at block granularity: each frame's
+    blocks attend to all blocks of the last ``window_frames`` frames
+    (optionally causal over frames). Returns (nqb, nkb) boolean."""
+    nb = num_frames * tokens_per_frame_blocks
+    mask = np.zeros((nb, nb), dtype=bool)
+    for f in range(num_frames):
+        f_lo = max(0, f - window_frames + 1)
+        f_hi = f + 1 if causal_frames else min(num_frames, f + window_frames)
+        q0, q1 = f * tokens_per_frame_blocks, (f + 1) * tokens_per_frame_blocks
+        k0, k1 = f_lo * tokens_per_frame_blocks, f_hi * tokens_per_frame_blocks
+        mask[q0:q1, k0:k1] = True
+    return mask

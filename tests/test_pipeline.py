@@ -169,3 +169,22 @@ def test_dispatch_roundtrip_and_position_ids():
     np.testing.assert_array_equal(
         np.asarray(x_d)[:, 0], pos.astype(np.float32) * 3
     )
+
+
+def test_pipeline_flag_matrix():
+    """Env-flag coverage via FlagCombGenerator (ref test_pipeline.py + 
+    flag_generator): every heuristic flag combo must stay correct."""
+    from magiattention_tpu.api import clear_cache
+    from magiattention_tpu.testing.flag_generator import (
+        FlagCombGenerator,
+        with_flags,
+    )
+
+    for combo in FlagCombGenerator("heuristic"):
+        with with_flags(combo):
+            clear_cache()
+            try:
+                run_pipeline("varlen_causal", 4, seed=7)
+            except AssertionError as e:
+                raise AssertionError(f"flags {combo}: {e}") from e
+    clear_cache()
