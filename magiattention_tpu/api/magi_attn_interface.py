@@ -66,10 +66,6 @@ def magi_attn_flex_key(
         q_ranges = AttnRanges.from_ranges(q_ranges)
     if not isinstance(k_ranges, AttnRanges):
         k_ranges = AttnRanges.from_ranges(k_ranges)
-    if total_seqlen_q != total_seqlen_k:
-        raise NotImplementedError(
-            "self-attention only for now (cross-attention in a later round)"
-        )
     mask_ints = tuple(
         AttnMaskType.normalize(t).to_int_type() for t in attn_mask_type
     )

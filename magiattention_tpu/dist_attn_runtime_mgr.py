@@ -77,7 +77,8 @@ class DistAttnRuntimeMgr:
             )
         )
         self.comm_meta, self.calc_meta = make_attn_meta_from_dispatch_meta(
-            self.bucket, self.dispatch_meta_q, key.config
+            self.bucket, self.dispatch_meta_q, key.config,
+            dispatch_meta_kv=self.dispatch_meta_kv,
         )
         overlap_cfg = key.config.overlap_config
         self.runtime = DistAttnRuntime(

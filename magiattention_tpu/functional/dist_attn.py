@@ -172,25 +172,27 @@ class DistAttnRuntime:
         cm, km = self.comm_meta, self.calc_meta
         self.cp_size = len(km.host_args)
         shard = km.shard_len
+        kv_shard = km.kv_shard_len
         total_recv = sum(km.recv_len_per_stage)
         self.num_stages = len(cm.kv_stages)
         if self.use_overlap is None:
             self.use_overlap = self.num_stages > 1
 
         bq, bk = default_blocks(
-            shard, shard + total_recv, self.block_q, self.block_k
+            shard, kv_shard + total_recv, self.block_q, self.block_k
         )
         self._bq, self._bk = bq, bk
 
         # merged (no-overlap) plan
         (self._merged_arrays, nqt, nkt, w, wt) = _stack_plans(
-            km.merged_args, shard, shard + total_recv, bq, bk
+            km.merged_args, shard, kv_shard + total_recv, bq, bk
         )
         self._merged_dims = (nqt, nkt, w, wt)
 
         if self.use_overlap:
             (self._host_arrays, hnqt, hnkt, hw, hwt) = _stack_plans(
-                km.host_args, shard, shard, bq, min(bk, _ceil_to(shard, 128))
+                km.host_args, shard, kv_shard,
+                bq, min(bk, _ceil_to(kv_shard, 128)),
             )
             self._host_dims = (hnqt, hnkt, hw, hwt)
             self._stage_arrays = []

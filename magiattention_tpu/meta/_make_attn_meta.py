@@ -18,6 +18,7 @@ def make_attn_meta_from_dispatch_meta(
     bucket: AttnBucket,
     dispatch_meta: DispatchMeta,
     config: DistAttnConfig | None = None,
+    dispatch_meta_kv: DispatchMeta | None = None,
 ) -> tuple[CommMeta, CalcMeta]:
     config = config or DistAttnConfig()
     solver = DistAttnSolver(
@@ -25,5 +26,6 @@ def make_attn_meta_from_dispatch_meta(
         dispatch_meta=dispatch_meta,
         overlap_config=config.overlap_config,
         split_alignment=config.grpcoll_config.split_alignment,
+        dispatch_meta_kv=dispatch_meta_kv,
     )
     return solver.solve()

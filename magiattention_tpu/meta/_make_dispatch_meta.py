@@ -115,13 +115,29 @@ def make_dispatch_meta_from_qk_ranges(
             )
             partitions = solver.solve(areas, cp_size).partitions
 
+    is_cross = total_seqlen_k != total_seqlen_q
     meta_q = DispatchMeta(
-        attn_type=AttnType.SELF_ATTN,
+        attn_type=AttnType.CROSS_ATTN if is_cross else AttnType.SELF_ATTN,
         total_seqlen=total_seqlen_q,
         chunk_size=chunk_size,
         cp_size=cp_size,
         partitions=partitions,
     )
-    # self-attn: kv follows q's assignment
-    meta_kv = meta_q
+    if is_cross:
+        # cross-attn: kv has its own (sequential, evenly chunked) dispatch —
+        # kv rows carry no per-row workload of their own
+        if total_seqlen_k % cp_size != 0:
+            raise ValueError(
+                f"total_seqlen_k {total_seqlen_k} not divisible by cp_size"
+            )
+        meta_kv = DispatchMeta(
+            attn_type=AttnType.CROSS_ATTN,
+            total_seqlen=total_seqlen_k,
+            chunk_size=total_seqlen_k // cp_size,
+            cp_size=cp_size,
+            partitions=[[r] for r in range(cp_size)],
+        )
+    else:
+        # self-attn: kv follows q's assignment
+        meta_kv = meta_q
     return meta_q, meta_kv, bucket

@@ -108,7 +108,8 @@ class CalcMeta:
             stage's remote-kv receive buffer).
         merged_args: rank -> slices over (local q, [kv shard | all remote kv])
             — the single-kernel concat path (ref dist_attn.py:3305 no-overlap).
-        shard_len: local q/kv rows per rank.
+        shard_len: local q rows per rank.
+        kv_shard_len: local kv rows per rank (== shard_len for self-attn).
         recv_len_per_stage: stage -> padded remote-kv rows (same on all ranks).
     """
 
@@ -117,3 +118,8 @@ class CalcMeta:
     merged_args: list[AttnArg]
     shard_len: int
     recv_len_per_stage: list[int] = field(default_factory=list)
+    kv_shard_len: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.kv_shard_len is None:
+            self.kv_shard_len = self.shard_len

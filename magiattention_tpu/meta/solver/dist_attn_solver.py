@@ -62,9 +62,12 @@ class DistAttnSolver:
         dispatch_meta: DispatchMeta,
         overlap_config: OverlapConfig | None = None,
         split_alignment: int = 128,
+        dispatch_meta_kv: DispatchMeta | None = None,
     ) -> None:
         self.bucket = bucket
         self.meta = dispatch_meta
+        # cross-attention: kv has its own dispatch (ownership) meta
+        self.meta_kv = dispatch_meta_kv or dispatch_meta
         self.cp_size = dispatch_meta.cp_size
         self.overlap_config = overlap_config or OverlapConfig()
         self.split_alignment = split_alignment
@@ -75,7 +78,9 @@ class DistAttnSolver:
         cp = self.cp_size
         meta = self.meta
         shard_len = meta.shard_seqlen
+        kv_shard_len = self.meta_kv.shard_seqlen
         host_ranges = meta.host_ranges_per_rank
+        kv_ranges = self.meta_kv.host_ranges_per_rank
         degree = max(1, self.overlap_config.degree or 1)
         if not self.overlap_config.enable:
             degree = 1
@@ -101,7 +106,7 @@ class DistAttnSolver:
                 chunk = chunks_by_id[chunk_id]
                 for s in chunk.attn_slices:
                     self._split_slice(
-                        s, r, own, host_ranges,
+                        s, r, own, kv_ranges,
                         host_slices[r], deferred[r], requests[r],
                     )
 
@@ -162,8 +167,8 @@ class DistAttnSolver:
             [[] for _ in range(cp)] for _ in range(degree)
         ]
         merged_slices: list[list[tuple[int, ...]]] = [list(hs) for hs in host_slices]
-        # merged buffer: [shard | stage0 | stage1 | ...]
-        stage_base = [shard_len]
+        # merged buffer: [kv shard | stage0 | stage1 | ...]
+        stage_base = [kv_shard_len]
         for st in range(1, degree):
             stage_base.append(stage_base[-1] + stage_recv_len[st - 1])
 
@@ -193,14 +198,14 @@ class DistAttnSolver:
         for st in range(degree):
             kv_stages.append(
                 self._make_group_collective_arg(
-                    intervals, host_ranges, st, stage_recv_len[st]
+                    intervals, kv_ranges, st, stage_recv_len[st]
                 )
             )
 
         total_recv = sum(stage_recv_len)
         calc_meta = CalcMeta(
             host_args=[
-                AttnArg.from_slices(host_slices[r], shard_len, shard_len)
+                AttnArg.from_slices(host_slices[r], shard_len, kv_shard_len)
                 for r in range(cp)
             ],
             remote_args_per_stage=[
@@ -214,12 +219,13 @@ class DistAttnSolver:
             ],
             merged_args=[
                 AttnArg.from_slices(
-                    merged_slices[r], shard_len, shard_len + total_recv
+                    merged_slices[r], shard_len, kv_shard_len + total_recv
                 )
                 for r in range(cp)
             ],
             shard_len=shard_len,
             recv_len_per_stage=stage_recv_len,
+            kv_shard_len=kv_shard_len,
         )
         return CommMeta(kv_stages=kv_stages), calc_meta
 
@@ -230,12 +236,17 @@ class DistAttnSolver:
         s: AttnSlice,
         rank: int,
         own: AttnRanges,
-        host_ranges: list[AttnRanges],
+        kv_ranges: list[AttnRanges],
         host_out: list[tuple[int, ...]],
         deferred_out: list[tuple[AttnRange, AttnRange, int, int, int]],
         requests_out: list[AttnRanges],
     ) -> None:
-        """Split one owned (chunk-clipped) slice into host/remote pieces."""
+        """Split one owned (chunk-clipped) slice into host/remote pieces.
+
+        ``own`` gives q-locality (this rank's q rows); ``kv_ranges`` gives kv
+        ownership per rank (== q ownership for self-attn, separate dispatch
+        for cross-attn).
+        """
         shrunk = s.shrink()
         if shrunk.q_range.is_empty():
             return
@@ -247,12 +258,13 @@ class DistAttnSolver:
             return
         needed = AttnRanges([needed_k])
         lo, hi = shrunk.d_lo, shrunk.d_hi
+        kv_own = kv_ranges[rank]
 
         # local parts
-        for part in needed.find_overlap_ranges(own):
-            for k_loc in own.make_ranges_local(AttnRanges([part])):
+        for part in needed.find_overlap_ranges(kv_own):
+            for k_loc in kv_own.make_ranges_local(AttnRanges([part])):
                 # recover the global start of this contiguous local piece
-                k_glob_start = _local_to_global(own, k_loc.start)
+                k_glob_start = _local_to_global(kv_own, k_loc.start)
                 koff = k_glob_start - k_loc.start
                 lo_l = lo if lo <= -BAND_INF else lo + qoff - koff
                 hi_l = hi if hi >= BAND_INF else hi + qoff - koff
@@ -261,12 +273,12 @@ class DistAttnSolver:
                 )
 
         # remote parts, split by owner
-        for hole in needed.find_hole_ranges(own):
+        for hole in needed.find_hole_ranges(kv_own):
             for src in range(self.cp_size):
                 if src == rank:
                     continue
                 for part in AttnRanges([hole]).find_overlap_ranges(
-                    host_ranges[src]
+                    kv_ranges[src]
                 ):
                     requests_out[src].append(part)
                     deferred_out.append((q_loc, part, lo, hi, qoff))

@@ -188,3 +188,39 @@ def test_pipeline_flag_matrix():
             except AssertionError as e:
                 raise AssertionError(f"flags {combo}: {e}") from e
     clear_cache()
+
+
+@pytest.mark.parametrize("mask_type", [FULL, CAUSAL])
+def test_pipeline_cross_attention(mask_type):
+    """sq != sk: kv gets its own sequential dispatch (AttnType.CROSS_ATTN)."""
+    SQ, SK = 256, 128
+    mesh = make_mesh(4)
+    key = magi_attn_flex_key(
+        [[0, SQ]], [[0, SK]], [mask_type], SQ, SK,
+        mesh=mesh, chunk_size=CHUNK,
+    )
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((SQ, H, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((SK, HK, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((SK, HK, D)), dtype=jnp.float32)
+
+    def fwd(q, k, v):
+        out_d, meta = calc_attn(
+            dispatch(q, key), dispatch(k, key, "kv"), dispatch(v, key, "kv"),
+            key,
+        )
+        return undispatch(out_d, key), undispatch(meta.lse, key)
+
+    out, lse = jax.jit(fwd)(q, k, v)
+    from magiattention_tpu.common.mask import slice_mask_block
+    from magiattention_tpu.common.range import AttnRange
+
+    mask = slice_mask_block(
+        AttnRange(0, SQ), AttnRange(0, SK),
+        AttnMaskType.from_int_type(mask_type),
+    )
+    out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
+                 msg=f"xattn {mask_type} out")
+    assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
+                 msg=f"xattn {mask_type} lse")
