@@ -8,3 +8,6 @@ kernel and XLA collectives.
 
 from .ulysses import ulysses_attn  # noqa: F401
 from .ring import ring_attn  # noqa: F401
+from .usp import usp_attn  # noqa: F401
+from .loongtrain import loongtrain_attn  # noqa: F401
+from .hybrid import allgather_attn, hybrid_cp_attn  # noqa: F401

@@ -1,0 +1,110 @@
+"""Shared host-side planning helpers for the CP baselines.
+
+Every baseline in this package walks the same recipe (ref
+exps/dist_attn/baselines/shard.py, utils_cp.py): clip the *global*
+band-slice metadata to a (q block, kv block) pair per (step, rank), build an
+FFA plan for each, and stack the plans into rank-sharded arrays so one traced
+SPMD program serves every rank.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels.ffa import FFAParams, _should_interpret
+from ..kernels.ffa_plan import build_ffa_plan, pad_plan
+from ..kernels.mask_utils import BAND_INF, types_to_bands
+
+PLAN_FIELDS = ("work_qt", "work_kt", "meta", "work_qt_t", "work_kt_t", "meta_t")
+
+
+def band_meta(q_ranges, k_ranges, attn_type_map):
+    """Normalize global slice metadata to (qr, kr, d_lo, d_hi) int32 arrays."""
+    qr = np.asarray(q_ranges, dtype=np.int32)
+    kr = np.asarray(k_ranges, dtype=np.int32)
+    tm = np.asarray(attn_type_map, dtype=np.int32)
+    lo, hi = types_to_bands(qr, kr, tm)
+    return qr, kr, lo, hi
+
+
+def ring_step_plans(qr, kr, lo, hi, shard: int, n: int, bq: int, bk: int):
+    """``plans[step][rank]`` for an n-rank KV ring over contiguous blocks of
+    ``shard`` rows: the kv block visiting rank r at step s came from rank
+    ``(r - s) % n``."""
+    plans = []
+    for s in range(n):
+        per_rank = []
+        for r in range(n):
+            src = (r - s) % n
+            slices = clip_to_blocks(
+                qr, kr, lo, hi,
+                r * shard, (r + 1) * shard,
+                src * shard, (src + 1) * shard,
+            )
+            per_rank.append(block_plan(slices, shard, shard, bq, bk))
+        plans.append(per_rank)
+    return plans
+
+
+def clip_to_blocks(
+    q_ranges, k_ranges, d_lo, d_hi, q0, q1, k0, k1
+) -> np.ndarray:
+    """Clip global band slices to q rows [q0,q1) x k cols [k0,k1), shifted to
+    block-local coordinates. Returns an ``(n, 6)`` int64 array of
+    ``(qs, qe, ks, ke, d_lo, d_hi)`` local slices."""
+    out = []
+    for i in range(len(q_ranges)):
+        qs, qe = max(int(q_ranges[i, 0]), q0), min(int(q_ranges[i, 1]), q1)
+        ks, ke = max(int(k_ranges[i, 0]), k0), min(int(k_ranges[i, 1]), k1)
+        if qs >= qe or ks >= ke:
+            continue
+        lo, hi = int(d_lo[i]), int(d_hi[i])
+        # local coords subtract block bases; shift band accordingly
+        lo_l = lo if lo <= -BAND_INF else lo + q0 - k0
+        hi_l = hi if hi >= BAND_INF else hi + q0 - k0
+        out.append((qs - q0, qe - q0, ks - k0, ke - k0, lo_l, hi_l))
+    return np.asarray(out, dtype=np.int64).reshape(-1, 6)
+
+
+def block_plan(slices: np.ndarray, sq: int, sk: int, bq: int, bk: int):
+    """FFA plan for one block pair from clipped ``(n, 6)`` slices."""
+    return build_ffa_plan(
+        slices[:, 0:2].astype(np.int32),
+        slices[:, 2:4].astype(np.int32),
+        slices[:, 4].astype(np.int32),
+        slices[:, 5].astype(np.int32),
+        sq, sk, bq, bk,
+    )
+
+
+def baseline_params(
+    plan0, w: int, wt: int, bq: int, bk: int,
+    scale: float, hq: int, hk: int,
+) -> FFAParams:
+    """The FFAParams every baseline shares (softcap-free, env interpret)."""
+    return FFAParams(
+        num_work=w, num_work_t=wt,
+        num_q_tiles=plan0.num_q_tiles,
+        num_k_tiles=plan0.num_k_tiles,
+        block_q=bq, block_k=bk,
+        softmax_scale=scale, softcap=0.0, group=hq // hk,
+        interpret=_should_interpret(),
+    )
+
+
+def stack_step_plans(plans: list[list]):
+    """``plans[step][rank]`` -> (per-step tuples of rank-stacked jnp arrays,
+    shared (num_work, num_work_t) caps)."""
+    w = max(p.num_work for ps in plans for p in ps)
+    wt = max(p.num_work_t for ps in plans for p in ps)
+    stacked = []
+    for per_rank in plans:
+        padded = [pad_plan(p, w, wt) for p in per_rank]
+        stacked.append(
+            tuple(
+                jnp.asarray(np.stack([getattr(p, f) for p in padded]))
+                for f in PLAN_FIELDS
+            )
+        )
+    return stacked, w, wt

@@ -1,0 +1,116 @@
+"""LoongTrain (2D double-ring) context-parallel baseline.
+
+Ref: exps/dist_attn/baselines/loongtrain.py — decomposes one big KV ring of
+size ``O*I`` into a double ring: an inner ring over the ``inner`` (intra-node
+on GPU; here first-ICI) axis and an outer ring over the ``outer`` axis. The
+inner ring makes ``I-1`` cheap hops per outer round; the outer hop happens
+once per round, so the expensive-axis traffic is ``O-1`` hops total instead
+of interleaved through every step — the "context-first" placement of the
+paper. On TPU both axes ride ICI collectives; the structure still reduces
+cross-slice (DCN) hops when the outer axis is mapped onto DCN.
+
+KV visiting rank ``(io, ii)`` at step ``(o, s)`` originates from global block
+``((io-o) % O) * I + ((ii-s) % I)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..functional.dist_attn import _multi_ffa
+from ..kernels.ffa import default_blocks
+from ._utils import (
+    band_meta,
+    baseline_params,
+    block_plan,
+    clip_to_blocks,
+    stack_step_plans,
+)
+
+
+def loongtrain_attn(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_ranges: np.ndarray,
+    k_ranges: np.ndarray,
+    attn_type_map: np.ndarray,
+    mesh: Mesh,
+    outer_axis: str = "rp_out",
+    inner_axis: str = "rp_in",
+    softmax_scale: float | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Sequence-sharded in/out over ``P((outer_axis, inner_axis))``.
+
+    Args:
+        q/k/v: ``(S, h, d)`` natural order, dim 0 sharded over both axes
+            (rank ``(io, ii)`` owns contiguous block ``io*I + ii``).
+
+    Returns:
+        (out ``(S, hq, dv)``, lse ``(S, hq)`` fp32), same sharding.
+    """
+    O = mesh.shape[outer_axis]
+    I = mesh.shape[inner_axis]
+    cp = O * I
+    S, hq, dh = q.shape
+    _, hk, dv = v.shape
+    shard = S // cp
+    scale = float(dh) ** -0.5 if softmax_scale is None else softmax_scale
+
+    qr, kr, lo, hi = band_meta(q_ranges, k_ranges, attn_type_map)
+
+    bq, bk = default_blocks(shard, shard)
+    # plans[o*I+s][global rank b = io*I+ii]
+    plans = []
+    for o in range(O):
+        for s in range(I):
+            per_rank = []
+            for io in range(O):
+                for ii in range(I):
+                    src = ((io - o) % O) * I + ((ii - s) % I)
+                    b = io * I + ii
+                    slices = clip_to_blocks(
+                        qr, kr, lo, hi,
+                        b * shard, (b + 1) * shard,
+                        src * shard, (src + 1) * shard,
+                    )
+                    per_rank.append(block_plan(slices, shard, shard, bq, bk))
+            plans.append(per_rank)
+    stacked, w, wt = stack_step_plans(plans)
+
+    params = baseline_params(plans[0][0], w, wt, bq, bk, scale, hq, hk)
+    params_list = tuple([params] * cp)
+    perm_in = [(i, (i + 1) % I) for i in range(I)]
+    perm_out = [(i, (i + 1) % O) for i in range(O)]
+
+    def f(q, k, v, step_arrays):
+        ks, vs = [], []
+        k_base, v_base = k, v
+        for o in range(O):
+            if o > 0:
+                k_base = jax.lax.ppermute(k_base, outer_axis, perm_out)
+                v_base = jax.lax.ppermute(v_base, outer_axis, perm_out)
+            k_cur, v_cur = k_base, v_base
+            for s in range(I):
+                if s > 0:
+                    k_cur = jax.lax.ppermute(k_cur, inner_axis, perm_in)
+                    v_cur = jax.lax.ppermute(v_cur, inner_axis, perm_in)
+                ks.append(k_cur)
+                vs.append(v_cur)
+        arrays_list = tuple(
+            tuple(a[0] for a in step_arrays[t]) for t in range(cp)
+        )
+        return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, params_list)
+
+    spec = P((outer_axis, inner_axis))
+    fn = shard_map(
+        f, mesh=mesh,
+        in_specs=(spec, spec, spec,
+                  [tuple(spec for _ in st) for st in stacked]),
+        out_specs=(spec, spec),
+        check_vma=False,
+    )
+    return fn(q, k, v, stacked)

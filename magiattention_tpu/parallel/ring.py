@@ -14,34 +14,13 @@ the ppermute chain transposes automatically under AD.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..functional.dist_attn import _multi_ffa
-from ..kernels.ffa import FFAParams, _should_interpret, default_blocks
-from ..kernels.ffa_plan import build_ffa_plan, pad_plan
-from ..kernels.mask_utils import BAND_INF, types_to_bands
-
-
-def _clip_to_blocks(
-    q_ranges, k_ranges, d_lo, d_hi, q0, q1, k0, k1
-) -> list[tuple[int, int, int, int, int, int]]:
-    """Clip global band slices to q rows [q0,q1) x k cols [k0,k1), shifted to
-    block-local coordinates."""
-    out = []
-    for i in range(len(q_ranges)):
-        qs, qe = max(int(q_ranges[i, 0]), q0), min(int(q_ranges[i, 1]), q1)
-        ks, ke = max(int(k_ranges[i, 0]), k0), min(int(k_ranges[i, 1]), k1)
-        if qs >= qe or ks >= ke:
-            continue
-        lo, hi = int(d_lo[i]), int(d_hi[i])
-        # local coords subtract block bases; shift band accordingly
-        lo_l = lo if lo <= -BAND_INF else lo + q0 - k0
-        hi_l = hi if hi >= BAND_INF else hi + q0 - k0
-        out.append((qs - q0, qe - q0, ks - k0, ke - k0, lo_l, hi_l))
-    return out
+from ..kernels.ffa import default_blocks
+from ._utils import band_meta, baseline_params, ring_step_plans, stack_step_plans
 
 
 def ring_attn(
@@ -70,56 +49,12 @@ def ring_attn(
     shard = S // cp
     scale = float(dh) ** -0.5 if softmax_scale is None else softmax_scale
 
-    qr = np.asarray(q_ranges, dtype=np.int32)
-    kr = np.asarray(k_ranges, dtype=np.int32)
-    tm = np.asarray(attn_type_map, dtype=np.int32)
-    lo, hi = types_to_bands(qr, kr, tm)
+    qr, kr, lo, hi = band_meta(q_ranges, k_ranges, attn_type_map)
 
     bq, bk = default_blocks(shard, shard)
-    # per (rank, step): kv block visiting rank r at step s came from rank
-    # (r - s) mod cp
-    plans = []
-    for s in range(cp):
-        per_rank = []
-        for r in range(cp):
-            src = (r - s) % cp
-            slices = _clip_to_blocks(
-                qr, kr, lo, hi,
-                r * shard, (r + 1) * shard,
-                src * shard, (src + 1) * shard,
-            )
-            arr = np.asarray(slices, dtype=np.int64).reshape(-1, 6)
-            per_rank.append(
-                build_ffa_plan(
-                    arr[:, 0:2].astype(np.int32),
-                    arr[:, 2:4].astype(np.int32),
-                    arr[:, 4].astype(np.int32),
-                    arr[:, 5].astype(np.int32),
-                    shard, shard, bq, bk,
-                )
-            )
-        plans.append(per_rank)
-
-    w = max(p.num_work for ps in plans for p in ps)
-    wt = max(p.num_work_t for ps in plans for p in ps)
-    stacked = []  # per step: tuple of 6 arrays shaped (cp, ...)
-    for s in range(cp):
-        padded = [pad_plan(p, w, wt) for p in plans[s]]
-        stacked.append(
-            tuple(
-                jnp.asarray(np.stack([getattr(p, f) for p in padded]))
-                for f in ("work_qt", "work_kt", "meta",
-                          "work_qt_t", "work_kt_t", "meta_t")
-            )
-        )
-    params = FFAParams(
-        num_work=w, num_work_t=wt,
-        num_q_tiles=plans[0][0].num_q_tiles,
-        num_k_tiles=plans[0][0].num_k_tiles,
-        block_q=bq, block_k=bk,
-        softmax_scale=scale, softcap=0.0, group=hq // hk,
-        interpret=_should_interpret(),
-    )
+    plans = ring_step_plans(qr, kr, lo, hi, shard, cp, bq, bk)
+    stacked, w, wt = stack_step_plans(plans)
+    params = baseline_params(plans[0][0], w, wt, bq, bk, scale, hq, hk)
     params_list = tuple([params] * cp)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 

@@ -9,7 +9,14 @@ from jax.sharding import Mesh
 from magiattention_tpu.common.enum import AttnMaskType
 from magiattention_tpu.common.mask import AttnMask
 from magiattention_tpu.common.ranges import AttnRanges
-from magiattention_tpu.parallel import ring_attn, ulysses_attn
+from magiattention_tpu.parallel import (
+    allgather_attn,
+    hybrid_cp_attn,
+    loongtrain_attn,
+    ring_attn,
+    ulysses_attn,
+    usp_attn,
+)
 from magiattention_tpu.testing import assert_close, ref_attn
 
 S, HQ, HK, D = 256, 4, 4, 32
@@ -28,9 +35,15 @@ CASES = {
 }
 
 
-def setup(case, seed=0):
+def setup(case, seed=0, ax_names=("cp",), shape=None):
     qr, kr, tm = CASES[case]
-    mesh = Mesh(np.array(jax.devices("cpu")[:CP]), axis_names=("cp",))
+    if shape is None:
+        mesh = Mesh(np.array(jax.devices("cpu")[:CP]), axis_names=ax_names)
+    else:
+        devs = np.array(
+            jax.devices("cpu")[: shape[0] * shape[1]]
+        ).reshape(shape)
+        mesh = Mesh(devs, axis_names=ax_names)
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=jnp.float32)
     k = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=jnp.float32)
@@ -63,6 +76,95 @@ def test_ring_forward(case):
     out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
     assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
     assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+def setup_2d(case, ax_names, shape=(2, 2), seed=0):
+    return setup(case, seed=seed, ax_names=ax_names, shape=shape)
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_usp_forward(case):
+    mesh, q, k, v, qr, kr, tm, mask = setup_2d(case, ("rp", "sp"))
+    out, lse = jax.jit(
+        lambda q, k, v: usp_attn(q, k, v, qr, kr, tm, mesh)
+    )(q, k, v)
+    out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+    assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_loongtrain_forward(case):
+    mesh, q, k, v, qr, kr, tm, mask = setup_2d(
+        case, ("rp_out", "rp_in"), shape=(2, 4)
+    )
+    out, lse = jax.jit(
+        lambda q, k, v: loongtrain_attn(q, k, v, qr, kr, tm, mesh)
+    )(q, k, v)
+    out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+    assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_hybrid_cp_forward(case):
+    mesh, q, k, v, qr, kr, tm, mask = setup_2d(
+        case, ("cp_inter", "cp_intra"), shape=(2, 4)
+    )
+    out, lse = jax.jit(
+        lambda q, k, v: hybrid_cp_attn(q, k, v, qr, kr, tm, mesh)
+    )(q, k, v)
+    out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+    assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_allgather_forward(case):
+    mesh, q, k, v, qr, kr, tm, mask = setup(case)
+    out, lse = jax.jit(
+        lambda q, k, v: allgather_attn(q, k, v, qr, kr, tm, mesh)
+    )(q, k, v)
+    out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+    assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+@pytest.mark.parametrize(
+    "which", ["usp", "hybrid", "loongtrain", "allgather"]
+)
+def test_more_backward(which):
+    if which == "usp":
+        mesh, q, k, v, qr, kr, tm, mask = setup_2d("causal", ("rp", "sp"))
+        attn = lambda q, k, v: usp_attn(q, k, v, qr, kr, tm, mesh)
+    elif which == "hybrid":
+        mesh, q, k, v, qr, kr, tm, mask = setup_2d(
+            "causal", ("cp_inter", "cp_intra"), shape=(2, 4)
+        )
+        attn = lambda q, k, v: hybrid_cp_attn(q, k, v, qr, kr, tm, mesh)
+    elif which == "loongtrain":
+        mesh, q, k, v, qr, kr, tm, mask = setup_2d(
+            "causal", ("rp_out", "rp_in"), shape=(2, 4)
+        )
+        attn = lambda q, k, v: loongtrain_attn(q, k, v, qr, kr, tm, mesh)
+    else:
+        mesh, q, k, v, qr, kr, tm, mask = setup("causal")
+        attn = lambda q, k, v: allgather_attn(q, k, v, qr, kr, tm, mesh)
+    rng = np.random.default_rng(9)
+    w = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=jnp.float32)
+
+    def loss(q, k, v):
+        out, _ = attn(q, k, v)
+        return jnp.sum(out * w)
+
+    def loss_ref(q, k, v):
+        out, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+        return jnp.sum(out * w)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), g, g_ref):
+        assert_close(a, b, atol=1e-3, rtol=1e-3, norm_rtol=3e-4, msg=name)
 
 
 def test_ring_backward():
