@@ -217,3 +217,138 @@ void magi_minheap_solve(const int64_t* areas, int64_t n, int64_t cp,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// dynamic-solver hot loop (ref: csrc/extensions/dyn_solver_alg.cpp:644
+// binary_greedy_parallel_solve)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Sorted disjoint interval set with merge-on-insert and intersection size.
+struct IntervalSet {
+  // start -> end, disjoint, sorted
+  std::vector<std::pair<int64_t, int64_t>> ivs;
+
+  int64_t intersect_len(int64_t s, int64_t e) const {
+    int64_t total = 0;
+    // binary search for first interval with end > s
+    auto it = std::lower_bound(
+        ivs.begin(), ivs.end(), s,
+        [](const std::pair<int64_t, int64_t>& iv, int64_t v) {
+          return iv.second <= v;
+        });
+    for (; it != ivs.end() && it->first < e; ++it) {
+      total += std::min(e, it->second) - std::max(s, it->first);
+    }
+    return total;
+  }
+
+  void insert(int64_t s, int64_t e) {
+    if (s >= e) return;
+    auto it = std::lower_bound(
+        ivs.begin(), ivs.end(), s,
+        [](const std::pair<int64_t, int64_t>& iv, int64_t v) {
+          return iv.second < v;
+        });
+    auto first = it;
+    while (it != ivs.end() && it->first <= e) {
+      s = std::min(s, it->first);
+      e = std::max(e, it->second);
+      ++it;
+    }
+    it = ivs.erase(first, it);
+    ivs.insert(it, {s, e});
+  }
+};
+
+struct BgState {
+  std::vector<IntervalSet> fq, fk;  // fetched q/k rows per rank
+  std::vector<int64_t> load;
+};
+
+constexpr int64_t kWQO = 2;
+constexpr int64_t kWKV = 2;
+
+bool bg_greedy(const int64_t* qs, const int64_t* qe, const int64_t* ks,
+               const int64_t* ke, const int64_t* area, const int32_t* qo,
+               const int32_t* ko, const std::vector<int64_t>& order,
+               int64_t n, int64_t cp, int64_t cap, int32_t* out) {
+  BgState st;
+  st.fq.resize(cp);
+  st.fk.resize(cp);
+  st.load.assign(cp, 0);
+  for (int64_t idx : order) {
+    int64_t best = -1;
+    int64_t best_comm = 0, best_load = 0;
+    for (int64_t r = 0; r < cp; ++r) {
+      if (st.load[r] + area[idx] > cap) continue;
+      int64_t comm = 0;
+      if (qo[idx] != r) {
+        comm += kWQO * (qe[idx] - qs[idx] -
+                        st.fq[r].intersect_len(qs[idx], qe[idx]));
+      }
+      if (ko[idx] != r) {
+        comm += kWKV * (ke[idx] - ks[idx] -
+                        st.fk[r].intersect_len(ks[idx], ke[idx]));
+      }
+      if (best < 0 || comm < best_comm ||
+          (comm == best_comm && st.load[r] < best_load)) {
+        best = r;
+        best_comm = comm;
+        best_load = st.load[r];
+      }
+    }
+    if (best < 0) return false;
+    out[idx] = static_cast<int32_t>(best);
+    st.load[best] += area[idx];
+    if (qo[idx] != best) st.fq[best].insert(qs[idx], qe[idx]);
+    if (ko[idx] != best) st.fk[best].insert(ks[idx], ke[idx]);
+  }
+  return true;
+}
+
+}  // namespace
+
+// LPT greedy under a per-rank area cap, binary-searched to the smallest
+// feasible cap. Tiles are (q,k)-owner-uniform; marginal comm cost is
+// dedup-aware via per-rank fetched interval sets. Returns 0 on success.
+extern "C" int32_t magi_binary_greedy_solve(const int64_t* qs, const int64_t* qe,
+                                 const int64_t* ks, const int64_t* ke,
+                                 const int64_t* area, const int32_t* q_owner,
+                                 const int32_t* k_owner, int64_t n,
+                                 int64_t cp, double slack, int64_t max_iters,
+                                 int32_t* out_assign) {
+  if (n == 0) return 0;
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(),
+            [&](int64_t x, int64_t y) { return area[x] > area[y]; });
+  int64_t total = 0, amax = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    total += area[i];
+    amax = std::max(amax, area[i]);
+  }
+  int64_t lo = std::max((total + cp - 1) / cp, amax);
+  int64_t hi = total;
+  std::vector<int32_t> best(n, -1);
+  std::vector<int32_t> trial(n);
+  for (int64_t it = 0; it < max_iters && lo <= hi; ++it) {
+    int64_t mid = (lo + hi) / 2;
+    if (bg_greedy(qs, qe, ks, ke, area, q_owner, k_owner, order, n, cp, mid,
+                  trial.data())) {
+      best = trial;
+      hi = static_cast<int64_t>(mid * (1.0 - slack)) - 1;
+    } else {
+      lo = mid + 1;
+    }
+  }
+  if (best[0] < 0) {
+    if (!bg_greedy(qs, qe, ks, ke, area, q_owner, k_owner, order, n, cp,
+                   total, best.data())) {
+      return -1;
+    }
+  }
+  std::memcpy(out_assign, best.data(), sizeof(int32_t) * n);
+  return 0;
+}

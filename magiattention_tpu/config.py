@@ -4,7 +4,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from .common.enum import AttnOverlapMode, DispatchAlgType, OverlapAlgType
+from .common.enum import (
+    AttnOverlapMode,
+    DispatchAlgType,
+    DynamicAttnAlgType,
+    OverlapAlgType,
+)
 
 
 @dataclass(frozen=True)
@@ -53,9 +58,24 @@ class GrpCollConfig:
 
 
 @dataclass(frozen=True)
+class DynamicAttnConfig:
+    """Config for the dynamic (qo-comm) solver.
+
+    Active when ``MAGI_ATTENTION_QO_COMM=1`` (env.comm.is_qo_comm_enable);
+    the reference forces overlap degree 1 under qo-comm (ref config.py:67-71)
+    and so do we — the dynamic plan is single-stage by construction.
+    """
+
+    alg: DynamicAttnAlgType = DynamicAttnAlgType.BINARY_GREEDY
+
+
+@dataclass(frozen=True)
 class DistAttnConfig:
     """Top-level distributed-attention config (passed per key-init)."""
 
     dispatch_config: DispatchConfig = field(default_factory=DispatchConfig)
     overlap_config: OverlapConfig = field(default_factory=OverlapConfig)
     grpcoll_config: GrpCollConfig = field(default_factory=GrpCollConfig)
+    dynamic_config: DynamicAttnConfig = field(
+        default_factory=DynamicAttnConfig
+    )

@@ -79,3 +79,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.magi_ranges_make_local.argtypes = [i32p, i64, i32p, i64, i32p]
     lib.magi_minheap_solve.restype = None
     lib.magi_minheap_solve.argtypes = [i64p, i64, i64, i64, i32p]
+    lib.magi_binary_greedy_solve.restype = ctypes.c_int32
+    lib.magi_binary_greedy_solve.argtypes = [
+        i64p, i64p, i64p, i64p, i64p, i32p, i32p,
+        i64, i64, ctypes.c_double, i64, i32p,
+    ]

@@ -44,6 +44,25 @@ def minheap_solve_native(
     return [np.nonzero(assign == r)[0].tolist() for r in range(cp_size)]
 
 
+def binary_greedy_solve(
+    qs: np.ndarray, qe: np.ndarray, ks: np.ndarray, ke: np.ndarray,
+    area: np.ndarray, q_owner: np.ndarray, k_owner: np.ndarray,
+    cp_size: int, slack: float, max_iters: int,
+) -> np.ndarray | None:
+    """Native BinaryGreedyParallel hot loop (ref dyn_solver_alg.cpp:644)."""
+    n = len(area)
+    out = np.empty(n, dtype=np.int32)
+    rc = get_lib().magi_binary_greedy_solve(
+        _i64p(np.ascontiguousarray(qs)), _i64p(np.ascontiguousarray(qe)),
+        _i64p(np.ascontiguousarray(ks)), _i64p(np.ascontiguousarray(ke)),
+        _i64p(np.ascontiguousarray(area)),
+        _i32p(np.ascontiguousarray(q_owner)),
+        _i32p(np.ascontiguousarray(k_owner)),
+        n, cp_size, float(slack), int(max_iters), _i32p(out),
+    )
+    return out if rc == 0 else None
+
+
 def ranges_merge_native(ranges: np.ndarray) -> np.ndarray:
     r = np.ascontiguousarray(ranges, dtype=np.int32).reshape(-1, 2)
     out = np.empty_like(r)

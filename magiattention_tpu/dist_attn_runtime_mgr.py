@@ -76,6 +76,26 @@ class DistAttnRuntimeMgr:
                 key.config.dispatch_config,
             )
         )
+        from .env import comm as env_comm
+
+        if env_comm.is_qo_comm_enable():
+            # dynamic (qo-comm) planner: q/o rows may move, overlap degree 1
+            # (ref config.py:67-71)
+            from .functional.dynamic_dist_attn import DynamicDistAttnRuntime
+            from .meta._make_attn_meta import make_dynamic_attn_plan
+
+            self.dynamic_plan = make_dynamic_attn_plan(
+                q_ranges, k_ranges, mask_types,
+                self.dispatch_meta_q, key.config,
+                dispatch_meta_kv=self.dispatch_meta_kv,
+            )
+            self.comm_meta = self.calc_meta = None
+            self.runtime = DynamicDistAttnRuntime(
+                plan=self.dynamic_plan, mesh=mesh, cp_axis=key.cp_axis
+            )
+            return
+
+        self.dynamic_plan = None
         self.comm_meta, self.calc_meta = make_attn_meta_from_dispatch_meta(
             self.bucket, self.dispatch_meta_q, key.config,
             dispatch_meta_kv=self.dispatch_meta_kv,

@@ -1,0 +1,309 @@
+"""Dynamic (qo-comm) CP engine.
+
+Ref: magi_attention/functional/dist_attn.py qo-comm paths (_fetch_remote_q
+:1625, _fetch_remote_qo_do_lse :1714, _reduce_partial_out_lse :1979,
+_reduce_partial_dq :2302) — the execution of a `DynamicAttnPlan`:
+
+forward (per rank, one shard_map program):
+  q_buf  = [q | group_cast(q)]          k_buf/v_buf likewise
+  out_buf, lse_buf = FFA(q_buf, k_buf, v_buf)
+  partial rows return to q owners (group_cast of out/lse over `ret`),
+  each owner lse-merges its row's contributions (merge_idx).
+
+backward (custom VJP, the distributed-flash identity): the owner computes
+delta = rowsum(do * out_final); (do, lse_final, delta) re-distribute to
+compute ranks over the SAME q_cast plan (out_buf rows correspond 1:1 to
+q_buf rows); each rank runs the FFA bwd kernels against the final lse/delta,
+which makes per-part dq/dkv exact with no gradient through the merge
+weights; dq/dkv partial rows reduce back to owners via the transposes of the
+two forward casts (`group_reduce_rows`). No collective beyond the forward's
+mirror image — zero-redundant in both directions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..comm.primitives import group_cast_rows, group_reduce_rows
+from ..env import general as env_general
+from ..kernels.ffa import (
+    FFAParams,
+    _ffa_bwd_dkv_pallas,
+    _ffa_bwd_dq_pallas,
+    _should_interpret,
+    default_blocks,
+    ffa_attn_with_plan,
+)
+from ..meta.collection.dynamic_meta import DynamicAttnPlan
+from .dist_attn import _head_major, _stack_plans
+from .utils import lse_weighted_reduce
+
+NEG_INF = float("-inf")
+
+
+def _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx):
+    """lse-merge each local row's contributions.
+
+    merge_idx: (shard, M) into [out_buf | ret_buf | dummy]."""
+    h, dv = out_buf.shape[1], out_buf.shape[2]
+    cat_out = jnp.concatenate(
+        [out_buf, ret_out, jnp.zeros((1, h, dv), out_buf.dtype)], axis=0
+    )
+    cat_lse = jnp.concatenate(
+        [lse_buf, ret_lse, jnp.full((1, h), NEG_INF, jnp.float32)], axis=0
+    )
+    co = jnp.take(cat_out, merge_idx, axis=0)  # (shard, M, h, dv)
+    cl = jnp.take(cat_lse, merge_idx, axis=0)  # (shard, M, h)
+    return lse_weighted_reduce(
+        co.transpose(1, 0, 2, 3), cl.transpose(1, 0, 2)
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _dyn_attn_shard(q, k, v, static, axis, comm, arrays):
+    out, lse, _, _, _ = _dyn_fwd_impl(q, k, v, static, axis, comm, arrays)
+    return out, lse
+
+
+def _dyn_fwd_impl(q, k, v, static, axis, comm, arrays):
+    params, shard, kv_shard = static
+    (q_send, q_recv, k_send, k_recv, r_send, r_recv, merge_idx) = comm
+    q_rem = group_cast_rows(q, q_send, q_recv, axis)
+    q_buf = jnp.concatenate([q, q_rem], axis=0)
+    k_rem = group_cast_rows(k, k_send, k_recv, axis)
+    v_rem = group_cast_rows(v, k_send, k_recv, axis)
+    k_buf = jnp.concatenate([k, k_rem], axis=0)
+    v_buf = jnp.concatenate([v, v_rem], axis=0)
+    out_buf, lse_buf = ffa_attn_with_plan(q_buf, k_buf, v_buf, arrays, params)
+    ret_out = group_cast_rows(out_buf, r_send, r_recv, axis)
+    ret_lse = group_cast_rows(lse_buf, r_send, r_recv, axis)
+    out, lse = _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx)
+    return out, lse, q_buf, k_buf, v_buf
+
+
+def _dyn_fwd(q, k, v, static, axis, comm, arrays):
+    out, lse, _, _, _ = _dyn_fwd_impl(q, k, v, static, axis, comm, arrays)
+    return (out, lse), (q, k, v, out, lse, comm, arrays)
+
+
+def _dyn_bwd(static, axis, res, cts):
+    do, _ = cts  # lse is auxiliary
+    q, k, v, out, lse, comm, arrays = res
+    params, shard, kv_shard = static
+    (q_send, q_recv, k_send, k_recv, _, _, _) = comm
+
+    # rebuild compute buffers (refetch — cheaper than saving the buffers,
+    # matching the reference's bwd-side comm)
+    q_rem = group_cast_rows(q, q_send, q_recv, axis)
+    q_buf = jnp.concatenate([q, q_rem], axis=0)
+    k_rem = group_cast_rows(k, k_send, k_recv, axis)
+    v_rem = group_cast_rows(v, k_send, k_recv, axis)
+    k_buf = jnp.concatenate([k, k_rem], axis=0)
+    v_buf = jnp.concatenate([v, v_rem], axis=0)
+
+    # owner-side final quantities, re-distributed over the q cast
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (shard, hq)
+    do_buf = jnp.concatenate(
+        [do, group_cast_rows(do, q_send, q_recv, axis)], axis=0
+    )
+    lse_buf = jnp.concatenate(
+        [lse, group_cast_rows(lse, q_send, q_recv, axis)], axis=0
+    )
+    delta_buf = jnp.concatenate(
+        [delta, group_cast_rows(delta, q_send, q_recv, axis)], axis=0
+    )
+
+    sqp = params.num_q_tiles * params.block_q
+    skp = params.num_k_tiles * params.block_k
+    q_t = _head_major(q_buf, sqp)
+    k_t = _head_major(k_buf, skp)
+    v_t = _head_major(v_buf, skp)
+    do_t = _head_major(do_buf, sqp)
+    nbuf = q_buf.shape[0]
+    lse_t = jnp.pad(
+        lse_buf, ((0, sqp - nbuf), (0, 0)), constant_values=NEG_INF
+    ).T
+    delta_t = jnp.pad(delta_buf, ((0, sqp - nbuf), (0, 0))).T
+
+    dq_t = _ffa_bwd_dq_pallas(
+        params, *arrays[:3], q_t, k_t, v_t, do_t, lse_t, delta_t
+    )
+    dk_t, dv_t = _ffa_bwd_dkv_pallas(
+        params, *arrays[3:6], q_t, k_t, v_t, do_t, lse_t, delta_t
+    )
+    g = params.group
+    if g > 1:
+        hq, skp_, dh = dk_t.shape
+        dk_t = dk_t.reshape(hq // g, g, skp_, dh).sum(axis=1)
+        dv_t = dv_t.reshape(hq // g, g, skp_, dv_t.shape[-1]).sum(axis=1)
+
+    dq_buf = dq_t.transpose(1, 0, 2)[:nbuf]
+    dk_buf = dk_t.transpose(1, 0, 2)[: k_buf.shape[0]]
+    dv_buf = dv_t.transpose(1, 0, 2)[: v_buf.shape[0]]
+
+    dq = dq_buf[:shard] + group_reduce_rows(
+        dq_buf[shard:], q_send, q_recv, axis, shard
+    )
+    dk = dk_buf[:kv_shard] + group_reduce_rows(
+        dk_buf[kv_shard:], k_send, k_recv, axis, kv_shard
+    )
+    dv = dv_buf[:kv_shard] + group_reduce_rows(
+        dv_buf[kv_shard:], k_send, k_recv, axis, kv_shard
+    )
+    return (
+        dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+        None, None,
+    )
+
+
+_dyn_attn_shard.defvjp(_dyn_fwd, _dyn_bwd)
+
+
+@dataclass(eq=False)
+class DynamicDistAttnRuntime:
+    """Executable runtime for one DynamicAttnPlan (qo-comm engine)."""
+
+    plan: DynamicAttnPlan
+    mesh: Mesh
+    cp_axis: str
+    softmax_scale: float | None = None
+    softcap: float = 0.0
+    block_q: int | None = None
+    block_k: int | None = None
+
+    def __post_init__(self) -> None:
+        p = self.plan
+        bq, bk = default_blocks(p.q_buf_len, p.k_buf_len,
+                                self.block_q, self.block_k)
+        self._bq, self._bk = bq, bk
+        (self._arrays, nqt, nkt, w, wt) = _stack_plans(
+            p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk
+        )
+        self._dims = (nqt, nkt, w, wt)
+        self._comm = (
+            jnp.asarray(p.q_cast.send_idx),
+            jnp.asarray(p.q_cast.recv_sel),
+            jnp.asarray(p.kv_cast.send_idx),
+            jnp.asarray(p.kv_cast.recv_sel),
+            jnp.asarray(p.ret.send_idx),
+            jnp.asarray(p.ret.recv_sel),
+            jnp.asarray(p.merge_idx),
+        )
+
+    @property
+    def backend(self) -> str:
+        return env_general.kernel_backend()
+
+    def calc_attn(
+        self, q: jax.Array, k: jax.Array, v: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """(out, lse) over dispatched tensors, qo-comm execution.
+
+        q/k/v: ``(cp*shard, h, d)`` dispatched layout sharded over cp axis.
+        """
+        p = self.plan
+        sq, hq, dh = q.shape
+        _, hk, dv = v.shape
+        group = hq // hk
+        scale = (
+            float(dh) ** -0.5
+            if self.softmax_scale is None
+            else self.softmax_scale
+        )
+        axis = self.cp_axis
+        spec = P(axis)
+
+        if self.backend in ("sdpa", "sdpa_online"):
+            return self._calc_attn_sdpa(q, k, v, scale)
+
+        nqt, nkt, w, wt = self._dims
+        params = FFAParams(
+            num_work=w, num_work_t=wt, num_q_tiles=nqt, num_k_tiles=nkt,
+            block_q=self._bq, block_k=self._bk,
+            softmax_scale=scale, softcap=self.softcap, group=group,
+            interpret=_should_interpret(),
+        )
+        static = (params, p.shard_len, p.kv_shard_len)
+
+        def f(q, k, v, comm, arrays):
+            comm_local = tuple(c[0] for c in comm)
+            arrays_local = tuple(a[0] for a in arrays)
+            return _dyn_attn_shard(
+                q, k, v, static, axis, comm_local, arrays_local
+            )
+
+        fn = shard_map(
+            f,
+            mesh=self.mesh,
+            in_specs=(spec, spec, spec,
+                      tuple(P(axis) for _ in self._comm),
+                      tuple(P(axis) for _ in self._arrays)),
+            out_specs=(spec, spec),
+            check_vma=False,
+        )
+        return fn(q, k, v, self._comm, self._arrays)
+
+    # -- jnp fake-backend path (fp32/fp64-exact distributed testing) -------
+
+    def _calc_attn_sdpa(self, q, k, v, scale):
+        from ..kernels.sdpa import sdpa_attn
+        from ..kernels.sdpa_online import sdpa_online_attn
+
+        p = self.plan
+        dense_fn = (
+            sdpa_attn if self.backend == "sdpa" else sdpa_online_attn
+        )
+        axis = self.cp_axis
+        spec = P(axis)
+        softcap = self.softcap
+
+        # per-rank slice arrays, stacked (pure jnp path, jax AD end-to-end —
+        # including the lse cotangent through the merge)
+        n_max = max(a.num_slices for a in p.attn_args) or 1
+        padded = [a.pad_to(n_max) for a in p.attn_args]
+        slices = tuple(
+            jnp.asarray(np.stack([getattr(a, f) for a in padded]))
+            for f in ("q_ranges", "k_ranges", "d_lo", "d_hi")
+        )
+
+        def f(q, k, v, comm, slices):
+            (q_send, q_recv, k_send, k_recv, r_send, r_recv, merge_idx) = (
+                tuple(c[0] for c in comm)
+            )
+            q_buf = jnp.concatenate(
+                [q, group_cast_rows(q, q_send, q_recv, axis)], axis=0
+            )
+            k_buf = jnp.concatenate(
+                [k, group_cast_rows(k, k_send, k_recv, axis)], axis=0
+            )
+            v_buf = jnp.concatenate(
+                [v, group_cast_rows(v, k_send, k_recv, axis)], axis=0
+            )
+            qr, kr, lo, hi = (a[0] for a in slices)
+            out_buf, lse_buf = dense_fn(
+                q_buf, k_buf, v_buf, qr, kr, None,
+                softmax_scale=scale, softcap=softcap, d_lo=lo, d_hi=hi,
+            )
+            ret_out = group_cast_rows(out_buf, r_send, r_recv, axis)
+            ret_lse = group_cast_rows(lse_buf, r_send, r_recv, axis)
+            return _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx)
+
+        fn = shard_map(
+            f,
+            mesh=self.mesh,
+            in_specs=(spec, spec, spec,
+                      tuple(P(axis) for _ in self._comm),
+                      tuple(P(axis) for _ in slices)),
+            out_specs=(spec, spec),
+            check_vma=False,
+        )
+        return fn(q, k, v, self._comm, slices)

@@ -1,15 +1,17 @@
 """Attn meta construction (ref: magi_attention/meta/_make_attn_meta.py:40-133).
 
-Picks the CP planner (static DistAttnSolver; the dynamic qo-comm solver plugs
-in here later), runs solve(), returns (CommMeta, CalcMeta).
+Picks the CP planner (static DistAttnSolver, or the dynamic qo-comm
+DynamicAttnSolver iff qo-comm is enabled — ref :62), runs solve().
 """
 
 from __future__ import annotations
 
+from ..common.rectangle import AttnRectangles
 from ..config import DistAttnConfig
 from .collection.calc_meta import CalcMeta
 from .collection.comm_meta import CommMeta
 from .collection.dispatch_meta import DispatchMeta
+from .collection.dynamic_meta import DynamicAttnPlan
 from .container.bucket import AttnBucket
 from .solver.dist_attn_solver import DistAttnSolver
 
@@ -27,5 +29,29 @@ def make_attn_meta_from_dispatch_meta(
         overlap_config=config.overlap_config,
         split_alignment=config.grpcoll_config.split_alignment,
         dispatch_meta_kv=dispatch_meta_kv,
+    )
+    return solver.solve()
+
+
+def make_dynamic_attn_plan(
+    q_ranges,
+    k_ranges,
+    attn_mask_type,
+    dispatch_meta: DispatchMeta,
+    config: DistAttnConfig | None = None,
+    dispatch_meta_kv: DispatchMeta | None = None,
+) -> DynamicAttnPlan:
+    """Build the qo-comm plan from global mask metadata (ref
+    dynamic_attn_solver.py:236 solve — rectangles-based global assignment)."""
+    from .solver.dynamic_attn_solver import DynamicAttnSolver
+
+    config = config or DistAttnConfig()
+    rects = AttnRectangles.from_ranges(q_ranges, k_ranges, attn_mask_type)
+    solver = DynamicAttnSolver(
+        rects=rects,
+        dispatch_meta_q=dispatch_meta,
+        dispatch_meta_kv=dispatch_meta_kv,
+        alg=config.dynamic_config.alg,
+        split_alignment=config.grpcoll_config.split_alignment,
     )
     return solver.solve()

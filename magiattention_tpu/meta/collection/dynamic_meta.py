@@ -1,0 +1,65 @@
+"""DynamicAttnPlan — the executable plan emitted by the dynamic (qo-comm)
+solver.
+
+Ref: magi_attention/meta/solver/dynamic_attn_solver.py:47-608 builds
+group-collective args for q, o, do, dq and kv; on TPU the backward-direction
+collectives need no separate args — they are the linear transposes of the two
+forward casts (q_cast, kv_cast) plus the return gather (ret), so the plan
+carries exactly three GroupCollectiveArgs and one merge-index matrix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .calc_meta import AttnArg
+from .comm_meta import GroupCollectiveArg
+
+
+@dataclass
+class DynamicAttnPlan:
+    """Host plan for one dynamic-solver solve.
+
+    Execution contract (per rank, inside shard_map):
+
+    1. ``q_buf = [local q shard | group_cast(q, q_cast)]``  (q_buf_len rows)
+    2. ``k_buf/v_buf = [local kv shard | group_cast(k/v, kv_cast)]``
+    3. ``out_buf, lse_buf = FFA(q_buf, k_buf, v_buf, attn_args[rank])``
+    4. ``ret_out/lse = group_cast(out_buf/lse_buf, ret)`` — partials return
+       to their q owners
+    5. per local row, lse-merge the rows selected by ``merge_idx`` from
+       ``[out_buf | ret_buf | dummy]`` (dummy = 0 / -inf).
+
+    Backward is the exact transpose: (do, lse, delta) re-distribute via
+    ``q_cast``; dq/dkv partial rows reduce back via the transposes of
+    ``q_cast`` / ``kv_cast``.
+    """
+
+    q_cast: GroupCollectiveArg
+    kv_cast: GroupCollectiveArg
+    ret: GroupCollectiveArg
+    attn_args: list[AttnArg]
+    merge_idx: np.ndarray  # (cp, shard, M) int32
+    shard_len: int
+    kv_shard_len: int
+    q_buf_len: int
+    k_buf_len: int
+    ret_len: int
+
+    @property
+    def cp_size(self) -> int:
+        return len(self.attn_args)
+
+    @property
+    def dummy_index(self) -> int:
+        return self.q_buf_len + self.ret_len
+
+    def comm_rows(self) -> dict[str, int]:
+        """Total communicated rows by stream (plan-quality metric)."""
+        return {
+            "q": int(self.q_cast.send_counts.sum()),
+            "kv": 2 * int(self.kv_cast.send_counts.sum()),
+            "out_lse": 2 * int(self.ret.send_counts.sum()),
+        }
