@@ -32,13 +32,20 @@ _runtime_dict = DistAttnRuntimeDict()
 _most_recent_key: DistAttnRuntimeKey | None = None
 
 
-def _auto_chunk_size(total_seqlen: int, cp_size: int) -> int:
-    """Pick the largest chunk <= 512 giving every rank >= 4 chunks and even
-    divisibility (ref :644-655 auto-derivation)."""
+def _auto_chunk_size(
+    total_seqlen: int, cp_size: int, uneven_shard: bool = False
+) -> int:
+    """Pick the largest chunk <= 512 giving every rank >= 4 chunks (ref
+    :644-655 auto-derivation). Uneven shard only needs
+    ``chunk_size | total_seqlen``; even shard additionally needs the chunk
+    count divisible by cp_size."""
     shard = total_seqlen // cp_size
     target = min(512, max(1, shard // 4))
     for cs in range(target, 0, -1):
-        if total_seqlen % (cs * cp_size) == 0:
+        if uneven_shard:
+            if total_seqlen % cs == 0:
+                return cs
+        elif total_seqlen % (cs * cp_size) == 0:
             return cs
     return 1
 
@@ -69,12 +76,23 @@ def magi_attn_flex_key(
     mask_ints = tuple(
         AttnMaskType.normalize(t).to_int_type() for t in attn_mask_type
     )
-    cp_size = mesh.shape[cp_axis]
+    if isinstance(cp_axis, (tuple, list)):
+        # 2D (dcn, ici) cp mesh — hierarchical comm capable
+        cp_axis = tuple(cp_axis)
+        cp_size = 1
+        for ax in cp_axis:
+            cp_size *= mesh.shape[ax]
+    else:
+        cp_size = mesh.shape[cp_axis]
     if chunk_size is None:
+        uneven = bool(
+            dist_attn_config
+            and dist_attn_config.dispatch_config.uneven_shard
+        )
         chunk_size = (
             dist_attn_config.dispatch_config.chunk_size
             if dist_attn_config and dist_attn_config.dispatch_config.chunk_size
-            else _auto_chunk_size(total_seqlen_q, cp_size)
+            else _auto_chunk_size(total_seqlen_q, cp_size, uneven)
         )
     config = dist_attn_config or DistAttnConfig()
 

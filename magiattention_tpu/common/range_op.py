@@ -1,0 +1,123 @@
+"""range_op — range-indexed fill / gather / reduce device ops.
+
+Ref: magi_attention/common/range_op/ (Triton kernels ``range_fill_`` :65,
+``range_gather`` :127, ``range_reduce`` with sum / avg / lse-weighted and a
+deterministic ordered variant, _range_reduce.py:80,360) — the post-processing
+stage of every group collective.
+
+TPU-native re-design: ranges are host metadata, so each op precomputes flat
+gather/scatter indices once (numpy) and lowers to a single fused XLA
+gather / scatter-add — no custom kernel needed, and XLA scatter-add is
+deterministic on TPU, so the "deterministic" ordered variant and the default
+coincide for sum/avg. The lse-weighted reduce merges range-pairs in list
+order (safe log-add-exp), which is the reference's ordered semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.utils import correct_attn_out_lse
+
+
+def _ranges_to_indices(ranges) -> np.ndarray:
+    """(N, 2) host ranges -> concatenated row indices."""
+    r = np.asarray(ranges, dtype=np.int64).reshape(-1, 2)
+    chunks = [np.arange(s, e, dtype=np.int32) for s, e in r if s < e]
+    if not chunks:
+        return np.zeros(0, dtype=np.int32)
+    return np.concatenate(chunks)
+
+
+def range_fill(x: jax.Array, ranges, value) -> jax.Array:
+    """Set rows covered by ``ranges`` to ``value`` (ref range_fill_ :65)."""
+    idx = _ranges_to_indices(ranges)
+    if len(idx) == 0:
+        return x
+    return x.at[jnp.asarray(idx)].set(value)
+
+
+def range_gather(x: jax.Array, ranges) -> jax.Array:
+    """Concatenate rows covered by ``ranges`` (ref range_gather :127)."""
+    idx = _ranges_to_indices(ranges)
+    return jnp.take(x, jnp.asarray(idx), axis=0)
+
+
+def range_scatter(x: jax.Array, ranges, rows: jax.Array) -> jax.Array:
+    """Inverse of range_gather: write ``rows`` into the covered positions."""
+    idx = _ranges_to_indices(ranges)
+    return x.at[jnp.asarray(idx)].set(rows[: len(idx)])
+
+
+def range_reduce(
+    out: jax.Array,
+    inp: jax.Array,
+    out_ranges,
+    inp_ranges,
+    op: str = "sum",
+    deterministic: bool = False,
+) -> jax.Array:
+    """Reduce ``inp`` range-blocks into ``out`` range-blocks.
+
+    Each pair ``(inp_ranges[i] -> out_ranges[i])`` (equal lengths) adds its
+    rows into the destination; overlapping destinations accumulate.
+    op: "sum" | "avg" (mean over contributions per destination row).
+    The ``deterministic`` flag is accepted for parity (ref
+    _range_reduce.py:80); XLA scatter-add is already deterministic on TPU.
+    """
+    del deterministic
+    oi = _ranges_to_indices(out_ranges)
+    ii = _ranges_to_indices(inp_ranges)
+    if len(oi) != len(ii):
+        raise ValueError(
+            f"range length mismatch: out {len(oi)} vs inp {len(ii)} rows"
+        )
+    if len(oi) == 0:
+        return out
+    oj = jnp.asarray(oi)
+    rows = jnp.take(inp, jnp.asarray(ii), axis=0)
+    if op == "sum":
+        return out.at[oj].add(rows)
+    if op == "avg":
+        # average over ALL partials of a destination row: the pre-existing
+        # out row counts as one contribution (ref avg_reduce_output)
+        counts = np.zeros(out.shape[0], dtype=np.int64)
+        np.add.at(counts, oi, 1)
+        acc = out.at[oj].add(rows)
+        denom = jnp.asarray((counts + 1).astype(np.float32))
+        shape = (-1,) + (1,) * (out.ndim - 1)
+        scale = jnp.where(
+            jnp.asarray(counts) > 0, 1.0 / denom, 1.0
+        ).reshape(shape)
+        return (acc.astype(jnp.float32) * scale).astype(out.dtype)
+    raise ValueError(f"unknown op: {op}")
+
+
+def range_lse_reduce(
+    out: jax.Array,
+    lse: jax.Array,
+    inp_out: jax.Array,
+    inp_lse: jax.Array,
+    out_ranges,
+    inp_ranges,
+) -> tuple[jax.Array, jax.Array]:
+    """LSE-weighted partial-attention reduce (ref range_lse_reduce_kernel
+    :239): for each range pair, merge the incoming partial (out, lse) rows
+    into the destination rows with the safe log-sum-exp identity. Pairs
+    merge in list order — the deterministic ordered semantics.
+    """
+    ro = np.asarray(out_ranges, dtype=np.int64)
+    ri = np.asarray(inp_ranges, dtype=np.int64)
+    for (os_, oe), (is_, ie) in zip(ro, ri):
+        if oe <= os_:
+            continue
+        o_rows = jax.lax.dynamic_slice_in_dim(out, os_, oe - os_, 0)
+        l_rows = jax.lax.dynamic_slice_in_dim(lse, os_, oe - os_, 0)
+        po = jax.lax.dynamic_slice_in_dim(inp_out, is_, ie - is_, 0)
+        pl = jax.lax.dynamic_slice_in_dim(inp_lse, is_, ie - is_, 0)
+        merged_o, merged_l = correct_attn_out_lse(o_rows, l_rows, po, pl)
+        out = jax.lax.dynamic_update_slice_in_dim(out, merged_o, os_, 0)
+        lse = jax.lax.dynamic_update_slice_in_dim(lse, merged_l, os_, 0)
+    return out, lse

@@ -14,15 +14,22 @@ from .common.enum import (
 
 @dataclass(frozen=True)
 class DispatchConfig:
-    """Config for the load-balance dispatch solver.
+    """Config for the load-balance dispatch solver (ref dispatch_solver.py:359).
 
     Attributes:
         alg: chunk->rank assignment algorithm.
         chunk_size: sequence chunk granularity; None = auto-derive.
+        top_p: candidate-pool fraction for the TOPP_HEAP algorithms.
+        max_backtracks: node budget for BACKTRACKING_PRUNING.
+        uneven_shard: allow ranks to own different chunk counts (shards are
+            padded to the max on-device; ref DispatchConfig.uneven_shard).
     """
 
     alg: DispatchAlgType = DispatchAlgType.MIN_HEAP
     chunk_size: int | None = None
+    top_p: float = 0.25
+    max_backtracks: int = 10_000
+    uneven_shard: bool = False
 
 
 @dataclass(frozen=True)

@@ -48,7 +48,7 @@ class DistAttnRuntimeKey:
     total_seqlen_k: int
     chunk_size: int
     cp_size: int
-    cp_axis: str
+    cp_axis: str | tuple[str, str]
     mesh_sig: tuple
     config: DistAttnConfig
     env_snapshot: tuple

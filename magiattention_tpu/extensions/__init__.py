@@ -1,0 +1,22 @@
+"""Drop-in attention interfaces (ref: extensions/magi_attn_extensions/).
+
+FA-style functions with attention sink (batch / varlen / qkvpacked, three
+generation aliases) and the DSA top-k sparse interface.
+"""
+
+from .dsa_interface import (  # noqa: F401
+    dsa_attn_func,
+    gather_sparse_fwd,
+    sdpa_sparse_fwd,
+)
+from .fa_interface_with_sink import (  # noqa: F401
+    fa2_func_with_sink,
+    fa2_qkvpacked_func_with_sink,
+    fa2_varlen_func_with_sink,
+    fa3_func_with_sink,
+    fa3_qkvpacked_func_with_sink,
+    fa3_varlen_func_with_sink,
+    fa4_func_with_sink,
+    fa4_qkvpacked_func_with_sink,
+    fa4_varlen_func_with_sink,
+)

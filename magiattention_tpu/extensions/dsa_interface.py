@@ -1,0 +1,133 @@
+"""DSA — per-token per-KV-head top-k sparse attention interface.
+
+Ref: extensions/magi_attn_extensions/dsa_interface.py:257 dsa_attn_func —
+q attends, per KV head, only the ``topk`` key tokens selected in
+``index_map``. The reference offers four backends (flex_attention /
+ffa block-sparse / ffa index-sparse / sdpa); on TPU:
+
+  "gather" — gather the selected K/V tokens per (kv head, q row) into a
+      dense ``(sq, topk)`` tile and run a fused softmax over it. This is
+      the MXU-native formulation: the irregular sparsity becomes a regular
+      gather + dense GEMM, the same trade the CuTe index-sparse kernel
+      makes on GPU.
+  "sdpa" — dense masked oracle (testing; O(sq*skv) memory).
+
+Both are pure jnp and differentiate end-to-end via jax AD.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+def gather_sparse_fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    index_map: jax.Array,
+    softmax_scale: float | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Gather-based top-k sparse attention (ref ffa_index_sparse_fwd).
+
+    Args:
+        q: ``(sq, hq, d)``; k/v: ``(skv, hk, d)``; ``hq % hk == 0``.
+        index_map: ``(hk, sq, topk)`` int32 selected key indices per kv head
+            (may contain duplicates; duplicates are masked to count once).
+
+    Returns:
+        (out ``(sq, hq, d)``, lse ``(sq, hq)`` fp32).
+    """
+    sq, hq, dh = q.shape
+    skv, hk, dv = v.shape
+    g = hq // hk
+    scale = dh ** -0.5 if softmax_scale is None else softmax_scale
+    topk = index_map.shape[-1]
+
+    # mask duplicate indices (scatter semantics in the sdpa oracle count a
+    # token once): keep the first occurrence along topk
+    idx = index_map.astype(jnp.int32)  # (hk, sq, topk)
+    first = jnp.min(
+        jnp.where(
+            idx[..., None, :] == idx[..., :, None],
+            jnp.arange(topk)[None, None, :, None],
+            topk,
+        ),
+        axis=-2,
+    )
+    keep = first == jnp.arange(topk)[None, None, :]
+
+    # (hk, sq, topk, d) gathered keys/values
+    k_h = k.transpose(1, 0, 2)  # (hk, skv, d)
+    v_h = v.transpose(1, 0, 2)
+    k_sel = jnp.take_along_axis(k_h[:, None], idx[..., None], axis=2)
+    v_sel = jnp.take_along_axis(v_h[:, None], idx[..., None], axis=2)
+
+    qg = q.reshape(sq, hk, g, dh)
+    logits = (
+        jnp.einsum("shgd,hstd->hgst", qg, k_sel).astype(jnp.float32) * scale
+    )
+    logits = jnp.where(keep[:, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    lse = (m + jnp.log(denom))[..., 0]  # (hk, g, sq)
+    out = jnp.einsum(
+        "hgst,hstd->shgd", (p / denom).astype(q.dtype), v_sel
+    ).reshape(sq, hq, dv)
+    return out, lse.transpose(2, 0, 1).reshape(sq, hq)
+
+
+def sdpa_sparse_fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    index_map: jax.Array,
+    softmax_scale: float | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Dense masked oracle (ref sdpa_sparse_fwd :202)."""
+    sq, hq, dh = q.shape
+    skv, hk, dv = v.shape
+    g = hq // hk
+    scale = dh ** -0.5 if softmax_scale is None else softmax_scale
+
+    # (hk, sq, skv) selection mask via one-hot scatter
+    mask = jnp.zeros((hk, sq, skv), dtype=bool)
+    hs = jnp.arange(hk)[:, None, None]
+    ss = jnp.arange(sq)[None, :, None]
+    mask = mask.at[hs, ss, index_map.astype(jnp.int32)].set(True)
+
+    qg = q.reshape(sq, hk, g, dh)
+    logits = (
+        jnp.einsum("shgd,thd->hgst", qg, k.astype(q.dtype)).astype(jnp.float32)
+        * scale
+    )
+    logits = jnp.where(mask[:, None], logits, NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)  # (hk, g, sq)
+    p = jnp.exp(logits - lse[..., None])
+    p = jnp.where(mask[:, None], p, 0.0)
+    out = jnp.einsum("hgst,thd->shgd", p.astype(q.dtype), v).reshape(
+        sq, hq, dv
+    )
+    return out, lse.transpose(2, 0, 1).reshape(sq, hq)
+
+
+def dsa_attn_func(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    index_map: jax.Array,
+    softmax_scale: float | None = None,
+    backend: str = "gather",
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k sparse attention entry (ref dsa_attn_func :257).
+
+    backend: "gather" (production, MXU-friendly) | "sdpa" (dense oracle).
+    """
+    if backend == "gather":
+        return gather_sparse_fwd(q, k, v, index_map, softmax_scale)
+    if backend == "sdpa":
+        return sdpa_sparse_fwd(q, k, v, index_map, softmax_scale)
+    raise ValueError(f"Invalid backend: {backend}")

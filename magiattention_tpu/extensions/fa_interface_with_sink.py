@@ -1,0 +1,193 @@
+"""Flash-attention drop-in interfaces with attention sink.
+
+Ref: extensions/magi_attn_extensions/fa{2,3,4}_interface_with_sink.py — the
+reference ships three kernel generations behind identical FA-style
+signatures (batch / varlen / qkvpacked, with causal + sliding-window +
+softcap + GQA + sink). On TPU all three map onto the single Pallas FFA
+kernel, so ``fa2_* / fa3_* / fa4_*`` share one implementation; the aliases
+exist for drop-in compatibility with call sites written against a specific
+generation.
+
+Windows follow FA semantics: query i attends keys j with
+``i + sk - sq - wl <= j <= i + sk - sq + wr`` (causal caps the right edge
+at the main diagonal) — which is exactly one diagonal band slice, the FFA
+kernel's native mask primitive.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.enum import AttnSinkLayout
+from ..functional.flex_flash_attn import flex_flash_attn_func
+from ..kernels.mask_utils import BAND_INF
+
+
+def _band(
+    sq: int, sk: int, causal: bool, window: tuple[int, int]
+) -> tuple[int, int]:
+    """FA window/causal semantics -> one (d_lo, d_hi) band (j - i bounds)."""
+    off = sk - sq
+    wl, wr = window
+    d_lo = off - wl if wl >= 0 else -BAND_INF
+    if causal:
+        d_hi = off if wr < 0 else min(off, off + wr)
+    else:
+        d_hi = off + wr if wr >= 0 else BAND_INF
+    return d_lo, d_hi
+
+
+def _check_sink(sink, sink_layout: AttnSinkLayout):
+    if sink is None:
+        return None
+    if sink_layout != "sh":
+        raise NotImplementedError(
+            f"sink_layout={sink_layout!r}: only the shared 'sh' "
+            f"(seqlen_sink, nheads) layout is implemented on TPU"
+        )
+    return sink
+
+
+def _run_packed(
+    q, k, v, qr, kr, d_lo, d_hi, sink, softmax_scale, softcap, backend
+):
+    out, meta = flex_flash_attn_func(
+        q, k, v, qr, kr, None,
+        softmax_scale=softmax_scale, softcap=softcap, sink=sink,
+        backend=backend,
+        d_lo=np.asarray(d_lo, np.int32), d_hi=np.asarray(d_hi, np.int32),
+    )
+    return out, meta.lse
+
+
+# ---------------------------------------------------------------------------
+# batch layout (b, s, h, d) — ref fa3_func_with_sink :763
+# ---------------------------------------------------------------------------
+
+
+def fa3_func_with_sink(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    sink: jax.Array | None = None,
+    sink_layout: AttnSinkLayout = "sh",
+    softmax_scale: float | None = None,
+    causal: bool = False,
+    window_size: tuple[int, int] = (-1, -1),
+    softcap: float = 0.0,
+    deterministic: bool = False,
+    return_attn_probs: bool = False,
+    backend: str | None = None,
+):
+    """FA-style batch attention with optional sink.
+
+    Args:
+        q/k/v: ``(b, s, h, d)`` / ``(b, sk, hk, d)``.
+        sink: ``(s_sink, h)`` shared sink logits (layout "sh").
+
+    Returns:
+        out ``(b, s, h, d)``; with ``return_attn_probs``, also lse
+        ``(b, h, s)`` fp32.
+    """
+    sink = _check_sink(sink, sink_layout)
+    b, sq, hq, dh = q.shape
+    _, sk, hk, dv = v.shape
+    d_lo, d_hi = _band(sq, sk, causal, window_size)
+
+    qp = q.reshape(b * sq, hq, dh)
+    kp = k.reshape(b * sk, hk, dh)
+    vp = v.reshape(b * sk, hk, dv)
+    qr = np.array([[i * sq, (i + 1) * sq] for i in range(b)], np.int32)
+    kr = np.array([[i * sk, (i + 1) * sk] for i in range(b)], np.int32)
+    # local band -> packed global coords: shift by kr.start - qr.start
+    d_lo_a = np.empty(b, np.int32)
+    d_hi_a = np.empty(b, np.int32)
+    for i in range(b):
+        shift = i * (sk - sq)
+        d_lo_a[i] = d_lo + shift if d_lo > -BAND_INF else -BAND_INF
+        d_hi_a[i] = d_hi + shift if d_hi < BAND_INF else BAND_INF
+    out, lse = _run_packed(
+        qp, kp, vp, qr, kr, d_lo_a, d_hi_a,
+        sink, softmax_scale, softcap, backend,
+    )
+    out = out.reshape(b, sq, hq, dv)
+    if return_attn_probs:
+        return out, lse.reshape(b, sq, hq).transpose(0, 2, 1)
+    return out
+
+
+def fa3_varlen_func_with_sink(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    cu_seqlens_q,
+    cu_seqlens_k,
+    max_seqlen_q: int | None = None,
+    max_seqlen_k: int | None = None,
+    sink: jax.Array | None = None,
+    sink_layout: AttnSinkLayout = "sh",
+    softmax_scale: float | None = None,
+    causal: bool = False,
+    window_size: tuple[int, int] = (-1, -1),
+    softcap: float = 0.0,
+    deterministic: bool = False,
+    return_attn_probs: bool = False,
+    backend: str | None = None,
+):
+    """FA varlen-style packed attention with optional sink (ref :858).
+
+    q/k/v are ``(total, h, d)`` packed; cu_seqlens are host metadata.
+    """
+    sink = _check_sink(sink, sink_layout)
+    cu_q = [int(x) for x in np.asarray(cu_seqlens_q)]
+    cu_k = [int(x) for x in np.asarray(cu_seqlens_k)]
+    n = len(cu_q) - 1
+    qr = np.array([[cu_q[i], cu_q[i + 1]] for i in range(n)], np.int32)
+    kr = np.array([[cu_k[i], cu_k[i + 1]] for i in range(n)], np.int32)
+    d_lo = np.empty(n, np.int32)
+    d_hi = np.empty(n, np.int32)
+    for i in range(n):
+        lsq, lsk = cu_q[i + 1] - cu_q[i], cu_k[i + 1] - cu_k[i]
+        # band in local coords; shift to global: j_g - i_g = (j_l + koff) -
+        # (i_l + qoff) with koff = kr.start, qoff = qr.start
+        lo, hi = _band(lsq, lsk, causal, window_size)
+        shift = kr[i, 0] - qr[i, 0]
+        d_lo[i] = max(-BAND_INF, lo + shift) if lo > -BAND_INF else -BAND_INF
+        d_hi[i] = min(BAND_INF, hi + shift) if hi < BAND_INF else BAND_INF
+    out, lse = _run_packed(
+        q, k, v, qr, kr, d_lo, d_hi, sink, softmax_scale, softcap, backend
+    )
+    if return_attn_probs:
+        return out, lse
+    return out
+
+
+def fa3_qkvpacked_func_with_sink(
+    qkv: jax.Array,
+    sink: jax.Array | None = None,
+    sink_layout: AttnSinkLayout = "sh",
+    softmax_scale: float | None = None,
+    causal: bool = False,
+    window_size: tuple[int, int] = (-1, -1),
+    softcap: float = 0.0,
+    deterministic: bool = False,
+    return_attn_probs: bool = False,
+    backend: str | None = None,
+):
+    """FA qkvpacked-style: qkv ``(b, s, 3, h, d)`` (ref :687)."""
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    return fa3_func_with_sink(
+        q, k, v, sink, sink_layout, softmax_scale, causal, window_size,
+        softcap, deterministic, return_attn_probs, backend,
+    )
+
+
+# fa2 / fa4 generations share the TPU kernel (ref fa2/fa4_interface_with_sink)
+fa2_func_with_sink = fa3_func_with_sink
+fa2_varlen_func_with_sink = fa3_varlen_func_with_sink
+fa2_qkvpacked_func_with_sink = fa3_qkvpacked_func_with_sink
+fa4_func_with_sink = fa3_func_with_sink
+fa4_varlen_func_with_sink = fa3_varlen_func_with_sink
+fa4_qkvpacked_func_with_sink = fa3_qkvpacked_func_with_sink

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..comm.primitives import group_cast_rows
+from ..env import comm as env_comm
 from ..env import general as env_general
 from ..kernels.ffa import (
     FFAParams,
@@ -159,7 +160,7 @@ class DistAttnRuntime:
     comm_meta: CommMeta
     calc_meta: CalcMeta
     mesh: Mesh
-    cp_axis: str
+    cp_axis: str | tuple[str, str]  # 2-tuple = 2D (dcn, ici) cp mesh
     softmax_scale: float | None = None
     softcap: float = 0.0
     block_q: int | None = None
@@ -207,12 +208,45 @@ class DistAttnRuntime:
                 self._stage_dims.append((snqt, snkt, sw, swt))
 
         # comm arrays (host-planned, stacked over ranks)
+        self._hier = (
+            isinstance(self.cp_axis, tuple)
+            and env_comm.is_hierarchical_comm_enable()
+            and cm.kv_host_ranges is not None
+        )
+        if self._hier:
+            # re-plan each stage 2-phase from its transfer table; the final
+            # receive buffer is flat-identical (comm/hier.py), so CalcMeta
+            # is untouched
+            from ..comm.hier import make_hier_group_cast_plan
+
+            dcn_axis, ici_axis = self.cp_axis
+            n_outer = self.mesh.shape[dcn_axis]
+            n_inner = self.mesh.shape[ici_axis]
+            self._hier_arrays = []
+            for st, s in enumerate(cm.kv_stages):
+                plan = make_hier_group_cast_plan(
+                    s.transfer_table, cm.kv_host_ranges, n_outer, n_inner,
+                    alignment=128, r_max=s.r_max, shard_len=kv_shard,
+                )
+                self._hier_arrays.append(tuple(
+                    jnp.asarray(a) for a in (
+                        plan.a_send_idx, plan.a_recv_sel,
+                        plan.b_send_idx, plan.b_recv_sel,
+                    )
+                ))
         self._send_idx = [
             jnp.asarray(s.send_idx) for s in cm.kv_stages
         ]  # each (cp, cp, A)
         self._recv_sel = [
             jnp.asarray(s.recv_sel) for s in cm.kv_stages
         ]  # each (cp, R)
+        # unified per-stage cast operand tuples (flat: 2 arrays; hier: 4)
+        if self._hier:
+            self._cast_ops = self._hier_arrays
+        else:
+            self._cast_ops = [
+                (si, rs) for si, rs in zip(self._send_idx, self._recv_sel)
+            ]
 
         # merged slice arrays for the jnp (sdpa) backend path: (cp, N, 2)/(cp, N)
         n_max = max(a.num_slices for a in km.merged_args) or 1
@@ -221,6 +255,18 @@ class DistAttnRuntime:
             jnp.asarray(np.stack([getattr(a, f) for a in padded]))
             for f in ("q_ranges", "k_ranges", "d_lo", "d_hi")
         )
+
+    def _cast(self, x, ops):
+        """One stage's GroupCast inside shard_map (flat or hierarchical)."""
+        if self._hier:
+            from ..comm.hier import hier_group_cast_rows
+
+            dcn_axis, ici_axis = self.cp_axis
+            return hier_group_cast_rows(
+                x, ops[0][0], ops[1][0], ops[2][0], ops[3][0],
+                dcn_axis, ici_axis,
+            )
+        return group_cast_rows(x, ops[0][0], ops[1][0], self.cp_axis)
 
     @property
     def backend(self) -> str:
@@ -272,11 +318,11 @@ class DistAttnRuntime:
             dense_fn = sdpa_attn if self.backend == "sdpa" else sdpa_online_attn
             softcap = self.softcap
 
-            def f(q, k, v, send_idxs, recv_sels, slices):
+            def f(q, k, v, cast_ops, slices):
                 parts_k, parts_v = [k], [v]
-                for si, rs in zip(send_idxs, recv_sels):
-                    parts_k.append(group_cast_rows(k, si[0], rs[0], axis))
-                    parts_v.append(group_cast_rows(v, si[0], rs[0], axis))
+                for ops in cast_ops:
+                    parts_k.append(self._cast(k, ops))
+                    parts_v.append(self._cast(v, ops))
                 k_all = jnp.concatenate(parts_k, axis=0)
                 v_all = jnp.concatenate(parts_v, axis=0)
                 qr, kr, lo, hi = (a[0] for a in slices)
@@ -290,27 +336,22 @@ class DistAttnRuntime:
                 f,
                 mesh=self.mesh,
                 in_specs=(spec, spec, spec,
-                          [P(axis) for _ in self._send_idx],
-                          [P(axis) for _ in self._recv_sel],
+                          [tuple(P(axis) for _ in ops)
+                           for ops in self._cast_ops],
                           tuple(P(axis) for _ in self._merged_slices)),
                 out_specs=(spec, spec),
                 check_vma=False,
             )
-            return fn(q, k, v, self._send_idx, self._recv_sel,
-                      self._merged_slices)
+            return fn(q, k, v, self._cast_ops, self._merged_slices)
 
         if not self.use_overlap:
             params = self._ffa_params(self._merged_dims, scale, group)
 
-            def f(q, k, v, send_idxs, recv_sels, arrays):
+            def f(q, k, v, cast_ops, arrays):
                 kv_parts_k, kv_parts_v = [k], [v]
-                for si, rs in zip(send_idxs, recv_sels):
-                    kv_parts_k.append(
-                        group_cast_rows(k, si[0], rs[0], axis)
-                    )
-                    kv_parts_v.append(
-                        group_cast_rows(v, si[0], rs[0], axis)
-                    )
+                for ops in cast_ops:
+                    kv_parts_k.append(self._cast(k, ops))
+                    kv_parts_v.append(self._cast(v, ops))
                 k_all = jnp.concatenate(kv_parts_k, axis=0)
                 v_all = jnp.concatenate(kv_parts_v, axis=0)
                 local_arrays = tuple(a[0] for a in arrays)
@@ -321,14 +362,13 @@ class DistAttnRuntime:
                 f,
                 mesh=self.mesh,
                 in_specs=(spec, spec, spec,
-                          [P(axis) for _ in self._send_idx],
-                          [P(axis) for _ in self._recv_sel],
+                          [tuple(P(axis) for _ in ops)
+                           for ops in self._cast_ops],
                           tuple(P(axis) for _ in self._merged_arrays)),
                 out_specs=(spec, spec),
                 check_vma=False,
             )
-            return fn(q, k, v, self._send_idx, self._recv_sel,
-                      self._merged_arrays)
+            return fn(q, k, v, self._cast_ops, self._merged_arrays)
 
         # multi-stage overlap path
         host_params = self._ffa_params(self._host_dims, scale, group)
@@ -338,13 +378,13 @@ class DistAttnRuntime:
 
         all_params = (host_params, *stage_params)
 
-        def f(q, k, v, send_idxs, recv_sels, host_arrays, stage_arrays):
+        def f(q, k, v, cast_ops, host_arrays, stage_arrays):
             # issue every stage's collective up front: no data dependence on
             # compute, XLA overlaps them with the host + earlier-stage kernels
             ks, vs = [k], [v]
-            for si, rs in zip(send_idxs, recv_sels):
-                ks.append(group_cast_rows(k, si[0], rs[0], axis))
-                vs.append(group_cast_rows(v, si[0], rs[0], axis))
+            for ops in cast_ops:
+                ks.append(self._cast(k, ops))
+                vs.append(self._cast(v, ops))
             arrays_list = (tuple(a[0] for a in host_arrays),) + tuple(
                 tuple(a[0] for a in sa) for sa in stage_arrays
             )
@@ -354,14 +394,14 @@ class DistAttnRuntime:
             f,
             mesh=self.mesh,
             in_specs=(spec, spec, spec,
-                      [P(axis) for _ in self._send_idx],
-                      [P(axis) for _ in self._recv_sel],
+                      [tuple(P(axis) for _ in ops)
+                       for ops in self._cast_ops],
                       tuple(P(axis) for _ in self._host_arrays),
                       [tuple(P(axis) for _ in sa) for sa in self._stage_arrays]),
             out_specs=(spec, spec),
             check_vma=False,
         )
-        return fn(q, k, v, self._send_idx, self._recv_sel,
+        return fn(q, k, v, self._cast_ops,
                   self._host_arrays, self._stage_arrays)
 
 

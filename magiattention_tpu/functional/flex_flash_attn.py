@@ -21,13 +21,17 @@ from functools import partial
 from ..env import general as env_general
 
 
-def _as_range_array(ranges: Any, name: str) -> jax.Array:
-    """Accept AttnRanges | array-like -> (N, 2) int32 jnp array."""
+def _as_range_array(ranges: Any, name: str) -> np.ndarray:
+    """Accept AttnRanges | array-like -> (N, 2) int32 HOST array.
+
+    Slice metadata must stay concrete even when the surrounding function is
+    jit-traced (it parameterizes the kernel grid); converting to jnp here
+    would stage it into the trace and break the host planners."""
     if hasattr(ranges, "to_array"):
         arr = ranges.to_array()
     else:
-        arr = np.asarray(ranges, dtype=np.int32)
-    arr = jnp.asarray(arr, dtype=jnp.int32)
+        arr = np.asarray(ranges)
+    arr = np.asarray(arr, dtype=np.int32)
     if arr.ndim != 2 or arr.shape[-1] != 2:
         raise ValueError(f"{name} must have shape (N, 2), got {arr.shape}")
     return arr
@@ -47,6 +51,8 @@ def flex_flash_attn_func(
     deterministic: bool = False,
     backend: str | None = None,
     return_max_logits: bool = False,
+    d_lo: Any = None,
+    d_hi: Any = None,
 ) -> tuple[jax.Array, AttnForwardMeta]:
     """Compute flex attention on one device.
 
@@ -66,9 +72,11 @@ def flex_flash_attn_func(
     qr = _as_range_array(q_ranges, "q_ranges")
     kr = _as_range_array(k_ranges, "k_ranges")
     if attn_type_map is None:
-        tmap = jnp.zeros((qr.shape[0],), dtype=jnp.int32)
+        # host constant (jnp.zeros would trace under jit, but the slice
+        # metadata must stay concrete — it parameterizes the kernel grid)
+        tmap = np.zeros((qr.shape[0],), dtype=np.int32)
     else:
-        tmap = jnp.asarray(np.asarray(attn_type_map), dtype=jnp.int32).reshape(-1)
+        tmap = np.asarray(attn_type_map, dtype=np.int32).reshape(-1)
 
     if backend is None:
         backend = env_general.kernel_backend()
@@ -84,7 +92,7 @@ def flex_flash_attn_func(
         out, lse = sdpa_attn(
             q, k, v, qr, kr, tmap,
             softmax_scale=softmax_scale, softcap=softcap,
-            compute_dtype=compute_dtype,
+            compute_dtype=compute_dtype, d_lo=d_lo, d_hi=d_hi,
         )
     elif backend == "sdpa_online":
         from ..kernels.sdpa_online import sdpa_online_attn
@@ -92,13 +100,14 @@ def flex_flash_attn_func(
         out, lse = sdpa_online_attn(
             q, k, v, qr, kr, tmap,
             softmax_scale=softmax_scale, softcap=softcap,
-            compute_dtype=compute_dtype,
+            compute_dtype=compute_dtype, d_lo=d_lo, d_hi=d_hi,
         )
     elif backend == "ffa":
         if sink is not None:
             out, lse = _ffa_with_sink(
                 q, k, v, sink, qr, kr, tmap,
                 softmax_scale=softmax_scale, softcap=softcap,
+                d_lo=d_lo, d_hi=d_hi,
             )
         else:
             from ..kernels.ffa import ffa_attn
@@ -106,6 +115,7 @@ def flex_flash_attn_func(
             out, lse = ffa_attn(
                 q, k, v, qr, kr, tmap,
                 softmax_scale=softmax_scale, softcap=softcap,
+                d_lo=d_lo, d_hi=d_hi,
             )
     else:
         raise ValueError(f"unknown kernel backend: {backend}")
@@ -131,7 +141,8 @@ def flex_flash_attn_func(
 
 
 def _ffa_with_sink(
-    q, k, v, sink, qr, kr, tmap, *, softmax_scale, softcap
+    q, k, v, sink, qr, kr, tmap, *, softmax_scale, softcap,
+    d_lo=None, d_hi=None,
 ):
     from functools import partial as _partial
 
@@ -147,7 +158,11 @@ def _ffa_with_sink(
     qr_np = np.asarray(qr, dtype=np.int32)
     kr_np = np.asarray(kr, dtype=np.int32)
     tm_np = np.asarray(tmap, dtype=np.int32)
-    d_lo, d_hi = types_to_bands(qr_np, kr_np, tm_np)
+    if d_lo is None or d_hi is None:
+        d_lo, d_hi = types_to_bands(qr_np, kr_np, tm_np)
+    else:
+        d_lo = np.asarray(d_lo, dtype=np.int32)
+        d_hi = np.asarray(d_hi, dtype=np.int32)
     sq, hq, d = q.shape
     sk, hk, dv = v.shape
     scale = float(d) ** -0.5 if softmax_scale is None else float(softmax_scale)

@@ -75,13 +75,13 @@ def make_dispatch_meta_from_qk_ranges(
             f"total_seqlen_q {total_seqlen_q} not divisible by chunk_size "
             f"{chunk_size}; pad first (api.compute_pad_size)"
         )
-    num_chunks = total_seqlen_q // chunk_size
-    if num_chunks % cp_size != 0:
-        raise ValueError(
-            f"num_chunks {num_chunks} not divisible by cp_size {cp_size}"
-        )
-
     dispatch_config = dispatch_config or DispatchConfig()
+    num_chunks = total_seqlen_q // chunk_size
+    if not dispatch_config.uneven_shard and num_chunks % cp_size != 0:
+        raise ValueError(
+            f"num_chunks {num_chunks} not divisible by cp_size {cp_size} "
+            f"(use DispatchConfig(uneven_shard=True) or pad)"
+        )
     bucket = make_global_bucket_from_qk_ranges(
         q_ranges, k_ranges, attn_mask_type, total_seqlen_q, chunk_size
     )
@@ -93,6 +93,7 @@ def make_dispatch_meta_from_qk_ranges(
         partitions = None
         if (
             dispatch_config.alg == DispatchAlgType.MIN_HEAP
+            and not dispatch_config.uneven_shard
             and _env.general.is_cpp_backend_enable()
         ):
             try:  # native hot loop (csrc/magi_host.cpp magi_minheap_solve)

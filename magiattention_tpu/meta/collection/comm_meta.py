@@ -52,9 +52,15 @@ class GroupCollectiveArg:
 
 @dataclass
 class CommMeta:
-    """All GroupCast stages of the forward pass (kv; qo-comm adds more)."""
+    """All GroupCast stages of the forward pass (kv; qo-comm adds more).
+
+    ``kv_host_ranges`` (per-rank merged global kv ownership) rides along so
+    the runtime can re-plan any stage hierarchically (comm/hier.py) from its
+    transfer table without consulting the solver again.
+    """
 
     kv_stages: list[GroupCollectiveArg] = field(default_factory=list)
+    kv_host_ranges: list | None = None
 
     @property
     def overlap_degree(self) -> int:

@@ -25,14 +25,7 @@ import random
 from dataclasses import dataclass, field
 
 from ...common.enum import DispatchAlgType
-
-
-@dataclass(frozen=True)
-class DispatchConfig:
-    alg: DispatchAlgType = DispatchAlgType.MIN_HEAP
-    chunk_size: int | None = None
-    top_p: float = 0.25
-    max_backtracks: int = 10_000
+from ...config import DispatchConfig  # canonical definition (config.py)
 
 
 @dataclass
@@ -61,12 +54,29 @@ class DispatchSolver:
         seed: int = 0,
     ) -> DispatchSolution:
         n = len(areas)
+        lb = self._lower_bound(areas, cp_size)
+        alg = self.alg
+
+        if self.config.uneven_shard:
+            # unequal chunk counts: pure min-makespan (LPT greedy, or exact
+            # refinement for the search algorithms); shards pad to the max
+            if alg == DispatchAlgType.SEQUENTIAL_SELECT and n % cp_size == 0:
+                parts = self._sequential(n, cp_size, n // cp_size)
+            elif alg == DispatchAlgType.BINARY_SEARCH:
+                parts = self._binary_search_uneven(areas, cp_size)
+            else:
+                parts = self._min_heap_uneven(areas, cp_size)
+            parts = [sorted(p) for p in parts]
+            max_area = max(
+                (sum(areas[i] for i in p) for p in parts), default=0
+            )
+            return DispatchSolution(
+                partitions=parts, max_area=max_area, lower_bound=lb
+            )
+
         if n % cp_size != 0:
             raise ValueError(f"num_chunks {n} not divisible by cp_size {cp_size}")
         k = n // cp_size
-        lb = self._lower_bound(areas, cp_size)
-
-        alg = self.alg
         if alg == DispatchAlgType.LOWER_BOUND:
             parts = self._sequential(n, cp_size, k)
         elif alg == DispatchAlgType.SEQUENTIAL_SELECT:
@@ -91,6 +101,55 @@ class DispatchSolver:
         parts = [sorted(p) for p in parts]
         max_area = max(sum(areas[i] for i in p) for p in parts)
         return DispatchSolution(partitions=parts, max_area=max_area, lower_bound=lb)
+
+    # -- uneven-shard variants --------------------------------------------
+
+    @staticmethod
+    def _min_heap_uneven(areas: list[int], cp: int) -> list[list[int]]:
+        """LPT greedy without the equal-count constraint: biggest chunk to
+        the least-loaded rank (every rank still gets >= 1 chunk when
+        possible, so no shard is empty)."""
+        n = len(areas)
+        order = sorted(range(n), key=lambda i: areas[i], reverse=True)
+        parts: list[list[int]] = [[] for _ in range(cp)]
+        # seed each rank with one chunk first (largest chunks spread out)
+        for r, i in enumerate(order[: min(cp, n)]):
+            parts[r].append(i)
+        heap = [(sum(areas[i] for i in parts[r]), r) for r in range(cp)]
+        heapq.heapify(heap)
+        for i in order[min(cp, n):]:
+            load, r = heapq.heappop(heap)
+            parts[r].append(i)
+            heapq.heappush(heap, (load + areas[i], r))
+        return parts
+
+    def _binary_search_uneven(
+        self, areas: list[int], cp: int
+    ) -> list[list[int]]:
+        """Makespan binary search + first-fit-decreasing, no count cap."""
+        n = len(areas)
+        order = sorted(range(n), key=lambda i: areas[i], reverse=True)
+        lo = self._lower_bound(areas, cp)
+        hi = sum(areas)
+        best = None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            loads = [0] * cp
+            parts: list[list[int]] = [[] for _ in range(cp)]
+            ok = True
+            for i in order:
+                r = min(range(cp), key=lambda r: loads[r])
+                if loads[r] + areas[i] > mid:
+                    ok = False
+                    break
+                parts[r].append(i)
+                loads[r] += areas[i]
+            if ok:
+                best = parts
+                hi = mid - 1
+            else:
+                lo = mid + 1
+        return best if best is not None else self._min_heap_uneven(areas, cp)
 
     # -- bounds ------------------------------------------------------------
 

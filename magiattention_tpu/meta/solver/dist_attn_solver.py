@@ -227,7 +227,10 @@ class DistAttnSolver:
             recv_len_per_stage=stage_recv_len,
             kv_shard_len=kv_shard_len,
         )
-        return CommMeta(kv_stages=kv_stages), calc_meta
+        return (
+            CommMeta(kv_stages=kv_stages, kv_host_ranges=list(kv_ranges)),
+            calc_meta,
+        )
 
     # ------------------------------------------------------------------
 

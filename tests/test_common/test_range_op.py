@@ -1,0 +1,79 @@
+"""range_op tests (ref: magi_attention/common/range_op/ Triton kernels)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from magiattention_tpu.common.range_op import (
+    range_fill,
+    range_gather,
+    range_lse_reduce,
+    range_reduce,
+    range_scatter,
+)
+from magiattention_tpu.functional.utils import lse_weighted_reduce
+
+
+def test_range_fill_and_gather_and_scatter():
+    x = jnp.asarray(np.arange(40, dtype=np.float32).reshape(10, 4))
+    ranges = [[1, 3], [7, 9]]
+    y = range_fill(x, ranges, 0.0)
+    assert float(y[1].sum()) == 0 and float(y[8].sum()) == 0
+    assert float(y[0].sum()) == float(x[0].sum())
+
+    g = range_gather(x, ranges)
+    np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(x)[[1, 2, 7, 8]]
+    )
+
+    z = range_scatter(jnp.zeros_like(x), ranges, g)
+    np.testing.assert_array_equal(np.asarray(z[1]), np.asarray(x[1]))
+    assert float(z[0].sum()) == 0
+
+
+def test_range_reduce_sum_overlapping_dsts():
+    out = jnp.zeros((6, 2))
+    inp = jnp.ones((8, 2))
+    # two source blocks landing on the same destination rows
+    out_r = [[0, 4], [0, 4]]
+    in_r = [[0, 4], [4, 8]]
+    r = range_reduce(out, inp, out_r, in_r, op="sum")
+    np.testing.assert_allclose(np.asarray(r[:4]), 2.0)
+    np.testing.assert_allclose(np.asarray(r[4:]), 0.0)
+
+
+def test_range_reduce_avg():
+    out = jnp.full((4, 1), 4.0)
+    inp = jnp.asarray([[1.0], [2.0]])
+    r = range_reduce(out, inp, [[0, 1], [0, 1]], [[0, 1], [1, 2]], op="avg")
+    # row 0: (4 + 1 + 2) / 3 contributions... local row counts as one:
+    # (4 + 1 + 2) / (2 + 1)
+    np.testing.assert_allclose(float(r[0, 0]), 7.0 / 3.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(r[1:]), 4.0)  # untouched
+
+
+def test_range_lse_reduce_matches_stacked_merge():
+    rng = np.random.default_rng(0)
+    s, h, d = 6, 2, 4
+    o1 = jnp.asarray(rng.standard_normal((s, h, d)), dtype=jnp.float32)
+    l1 = jnp.asarray(rng.standard_normal((s, h)), dtype=jnp.float32)
+    o2 = jnp.asarray(rng.standard_normal((s, h, d)), dtype=jnp.float32)
+    l2 = jnp.asarray(rng.standard_normal((s, h)), dtype=jnp.float32)
+
+    out, lse = range_lse_reduce(o1, l1, o2, l2, [[0, s]], [[0, s]])
+    ref_o, ref_l = lse_weighted_reduce(
+        jnp.stack([o1, o2]), jnp.stack([l1, l2])
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_o), rtol=2e-6,
+                               atol=2e-6)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_l), rtol=2e-6,
+                               atol=2e-6)
+
+
+def test_range_lse_reduce_neginf_partial_is_noop():
+    o1 = jnp.ones((4, 1, 2))
+    l1 = jnp.zeros((4, 1))
+    o2 = jnp.zeros((4, 1, 2))
+    l2 = jnp.full((4, 1), -jnp.inf)
+    out, lse = range_lse_reduce(o1, l1, o2, l2, [[0, 4]], [[0, 4]])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(o1))
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(l1))

@@ -1,0 +1,94 @@
+"""NSA baseline tests (ref: exps/dist_attn/baselines/nsa.py, usp_nsa.py).
+
+The distributed oracle: usp_nsa_attn on a 2x4 (ring x ulysses) virtual mesh
+must reproduce the single-device nsa_attn bit-for-bit (same params, same
+static block layout).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu.parallel.nsa import (
+    init_nsa_params,
+    nsa_attn,
+    usp_nsa_attn,
+)
+
+S, HQ, HK, D = 256, 4, 2, 32
+CU = [0, 128, 256]
+KW = dict(
+    l_cmp=16, l_slc=32, d_stride=16, block_size_q=16, slc_top_k=2,
+    window=(32, 0), causal=True,
+)
+
+
+def _inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=jnp.float32)
+    params = init_nsa_params(jax.random.PRNGKey(1), D, KW["l_cmp"])
+    return q, k, v, params
+
+
+def test_nsa_shapes_and_finite():
+    q, k, v, params = _inputs()
+    out = jax.jit(
+        lambda q, k, v: nsa_attn(q, k, v, params, CU, **KW)
+    )(q, k, v)
+    assert out.shape == (S, HQ, D)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_nsa_grads_flow():
+    q, k, v, params = _inputs()
+
+    def loss(params, q, k, v):
+        return jnp.sum(nsa_attn(q, k, v, params, CU, **KW) ** 2)
+
+    gp, gq = jax.jit(jax.grad(loss, argnums=(0, 1)))(params, q, k, v)
+    for name, g in gp.items():
+        assert bool(jnp.isfinite(g).all()), name
+        assert float(jnp.abs(g).sum()) > 0, f"no grad to {name}"
+    assert float(jnp.abs(gq).sum()) > 0
+
+
+def test_usp_nsa_matches_single_device():
+    q, k, v, params = _inputs()
+    ref = jax.jit(lambda q, k, v: nsa_attn(q, k, v, params, CU, **KW))(q, k, v)
+
+    devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    mesh = Mesh(devs, axis_names=("rp", "sp"))
+    # HK=2 not divisible by sp=4 -> use sp=2 mesh instead
+    devs = np.array(jax.devices("cpu")[:8]).reshape(4, 2)
+    mesh = Mesh(devs, axis_names=("rp", "sp"))
+    out = jax.jit(
+        lambda q, k, v: usp_nsa_attn(
+            q, k, v, params, CU, mesh, ring_axis="rp", ulysses_axis="sp",
+            **KW,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_nsa_selection_is_block_uniform():
+    """All rows of one q block share the same top-k selection (ref
+    compute_blockq_p_slc) — verified indirectly: permuting rows within a q
+    block permutes outputs of the slc+cmp branches identically."""
+    q, k, v, params = _inputs()
+    # unbounded non-causal window: every branch is then row-position
+    # independent, so within-block row permutation must commute
+    kw = {**KW, "window": (-1, -1), "causal": False}
+    out1 = nsa_attn(q, k, v, params, CU, **kw)
+    bs = KW["block_size_q"]
+    perm = np.arange(S)
+    perm[:bs] = perm[:bs][::-1]  # reverse the first q block
+    out2 = nsa_attn(q[perm], k, v, params, CU, **kw)
+    np.testing.assert_allclose(
+        np.asarray(out2), np.asarray(out1[perm]), rtol=2e-5, atol=2e-5
+    )
