@@ -17,6 +17,8 @@ from .magi_attn_interface import (  # noqa: F401
     get_position_ids,
     magi_attn_flex_key,
     magi_attn_varlen_key,
+    make_flex_key_for_new_mask_after_dispatch,
+    make_varlen_key_for_new_mask_after_dispatch,
     roll,
     undispatch,
 )

@@ -141,6 +141,83 @@ def magi_attn_varlen_key(
     )
 
 
+def make_flex_key_for_new_mask_after_dispatch(
+    q_ranges,
+    k_ranges,
+    attn_mask_type,
+    key_for_dispatch: DistAttnRuntimeKey,
+    dist_attn_config: DistAttnConfig | None = None,
+) -> DistAttnRuntimeKey:
+    """New mask, same dispatch solution (ref :1320).
+
+    For hybrid-attn models applying several masks in one pass: one mask is
+    chosen for dispatch (load balance + comm optimization follow it); the
+    others reuse its chunk->rank assignment with freshly-solved comm/calc
+    plans. No balance guarantee for the extra masks (ref WARNING).
+    """
+    global _most_recent_key
+    mgr0 = _mgr(key_for_dispatch)
+    if not isinstance(q_ranges, AttnRanges):
+        q_ranges = AttnRanges.from_ranges(q_ranges)
+    if not isinstance(k_ranges, AttnRanges):
+        k_ranges = AttnRanges.from_ranges(k_ranges)
+    mask_ints = tuple(
+        AttnMaskType.normalize(t).to_int_type() for t in attn_mask_type
+    )
+    old = key_for_dispatch
+    if q_ranges.end > old.total_seqlen_q or k_ranges.end > old.total_seqlen_k:
+        raise ValueError(
+            f"new mask exceeds the dispatched extent: q end {q_ranges.end} "
+            f"(max {old.total_seqlen_q}), k end {k_ranges.end} "
+            f"(max {old.total_seqlen_k}) — the re-keyed mask must fit the "
+            f"layout planned by key_for_dispatch"
+        )
+    key = DistAttnRuntimeKey(
+        q_ranges=tuple(q_ranges.to_naive_ranges()),
+        k_ranges=tuple(k_ranges.to_naive_ranges()),
+        attn_mask_type=mask_ints,
+        total_seqlen_q=old.total_seqlen_q,
+        total_seqlen_k=old.total_seqlen_k,
+        chunk_size=old.chunk_size,
+        cp_size=old.cp_size,
+        cp_axis=old.cp_axis,
+        mesh_sig=old.mesh_sig,
+        config=dist_attn_config or old.config,
+        env_snapshot=snapshot_env(),
+        fixed_partitions=tuple(
+            tuple(p) for p in mgr0.dispatch_meta_q.partitions
+        ),
+    )
+    _runtime_dict.get_or_create(key, mgr0.mesh)
+    _most_recent_key = key
+    return key
+
+
+def make_varlen_key_for_new_mask_after_dispatch(
+    cu_seqlens_q,
+    cu_seqlens_k,
+    key_for_dispatch: DistAttnRuntimeKey,
+    causal: bool = False,
+    window_size: tuple[int, int] = (-1, -1),
+    dist_attn_config: DistAttnConfig | None = None,
+) -> DistAttnRuntimeKey:
+    """Varlen convenience form of re-keying (ref :1172)."""
+    q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
+        cu_seqlens_q, cu_seqlens_k, causal
+    )
+    if window_size != (-1, -1):
+        if causal:
+            raise ValueError("window_size requires causal=False (ref :1203)")
+        from .functools import infer_attn_mask_from_sliding_window
+
+        q_ranges, k_ranges, types = infer_attn_mask_from_sliding_window(
+            q_ranges, k_ranges, types, window_size
+        )
+    return make_flex_key_for_new_mask_after_dispatch(
+        q_ranges, k_ranges, types, key_for_dispatch, dist_attn_config
+    )
+
+
 def _mgr(key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr:
     mgr = _runtime_dict.get(key)
     if mgr is None:

@@ -52,6 +52,10 @@ class DistAttnRuntimeKey:
     mesh_sig: tuple
     config: DistAttnConfig
     env_snapshot: tuple
+    # pinned chunk->rank assignment: set when re-keying a new mask after
+    # dispatch (ref api :1172 make_*_key_for_new_mask_after_dispatch) so the
+    # new mask reuses the old dispatch solution
+    fixed_partitions: tuple[tuple[int, ...], ...] | None = None
 
 
 class DistAttnRuntimeMgr:
@@ -74,6 +78,11 @@ class DistAttnRuntimeMgr:
                 key.chunk_size,
                 key.cp_size,
                 key.config.dispatch_config,
+                preset_partitions=(
+                    [list(p) for p in key.fixed_partitions]
+                    if key.fixed_partitions is not None
+                    else None
+                ),
             )
         )
         from .env import comm as env_comm
@@ -150,8 +159,61 @@ class DistAttnRuntimeMgr:
 
         return jnp.asarray(self.dispatch_meta_q.position_ids.reshape(-1))
 
-    def get_xattn_args(self) -> Any:
-        raise NotImplementedError("cross-attention args arrive in a later round")
+    def get_xattn_args(
+        self,
+        ref_xattn_q_ranges: AttnRanges,
+        ref_xattn_k_ranges: AttnRanges,
+        attn_mask_type=None,
+        return_host_only: bool = True,
+    ) -> Any:
+        """Cross-attention args for the dispatched q layout (ref :269-357).
+
+        The dispatched q tensor is chunk-permuted; to cross-attend it
+        against a NEW (replicated, undistributed) kv tensor, each global
+        (q_range, k_range) pair must be re-expressed in local dispatched q
+        coordinates. Only FULL masks are supported (ref asserts the same).
+
+        Returns:
+            ``return_host_only=True``: this API is SPMD — returns the
+            rank-stacked list of per-rank :class:`AttnArg` (the caller
+            selects its shard inside shard_map); ``False`` returns the same
+            list (kept for signature parity).
+        """
+        from .common.enum import AttnMaskType as _MT
+        from .kernels.mask_utils import BAND_INF
+        from .meta.collection.calc_meta import AttnArg
+
+        if len(ref_xattn_q_ranges) != len(ref_xattn_k_ranges):
+            raise ValueError(
+                f"q/k range count mismatch: {len(ref_xattn_q_ranges)} vs "
+                f"{len(ref_xattn_k_ranges)}"
+            )
+        if attn_mask_type is not None:
+            types = (
+                attn_mask_type
+                if isinstance(attn_mask_type, list)
+                else [attn_mask_type] * len(ref_xattn_q_ranges)
+            )
+            assert all(
+                _MT.normalize(t) == _MT.FULL for t in types
+            ), "only FULL cross-attn masks supported (ref :293)"
+
+        meta = self.dispatch_meta_q
+        shard = meta.shard_seqlen
+        sk = ref_xattn_k_ranges.end
+        args = []
+        for rank in range(meta.cp_size):
+            own = meta.host_ranges_per_rank[rank]
+            slices = []
+            for qr, kr in zip(ref_xattn_q_ranges, ref_xattn_k_ranges):
+                for piece in AttnRanges([qr]).find_overlap_ranges(own):
+                    q_loc = own.make_range_local(piece)
+                    slices.append(
+                        (q_loc.start, q_loc.end, kr.start, kr.end,
+                         -BAND_INF, BAND_INF)
+                    )
+            args.append(AttnArg.from_slices(slices, shard, sk))
+        return args
 
 
 class DistAttnRuntimeDict:

@@ -64,6 +64,7 @@ def make_dispatch_meta_from_qk_ranges(
     chunk_size: int,
     cp_size: int,
     dispatch_config: DispatchConfig | None = None,
+    preset_partitions: list[list[int]] | None = None,
 ) -> tuple[DispatchMeta, DispatchMeta, AttnBucket]:
     """Build (q_meta, kv_meta, global_bucket) for self-attention.
 
@@ -87,7 +88,11 @@ def make_dispatch_meta_from_qk_ranges(
     )
     areas = bucket.areas_per_chunk
 
-    if cp_size == 1:
+    if preset_partitions is not None:
+        # re-keying after dispatch: reuse a prior dispatch solution for a
+        # new mask (ref api :1172) — no balance guarantee for the new mask
+        partitions = [sorted(p) for p in preset_partitions]
+    elif cp_size == 1:
         partitions = [list(range(num_chunks))]
     else:
         partitions = None
@@ -114,7 +119,29 @@ def make_dispatch_meta_from_qk_ranges(
             solver = DispatchSolver(
                 alg=dispatch_config.alg, config=dispatch_config
             )
-            partitions = solver.solve(areas, cp_size).partitions
+            affinities = None
+            if dispatch_config.alg in (
+                DispatchAlgType.TOPP_HEAP,
+                DispatchAlgType.BATCH_TOPP_HEAP,
+            ) and not dispatch_config.uneven_shard:
+                # (the uneven solve path balances by pure LPT and does not
+                # consume affinities)
+                # IOU affinity: each chunk's kv coverage — co-locating
+                # overlapping coverage deduplicates GroupCast volume
+                from .solver.dispatch_solver import IOUAffinity
+
+                affinities = [
+                    IOUAffinity.from_ranges(
+                        AttnRanges(
+                            [AttnRange(s.k_range.start, s.k_range.end)
+                             for s in chunk.attn_slices]
+                        )
+                    )
+                    for chunk in bucket.q_chunks
+                ]
+            partitions = solver.solve(
+                areas, cp_size, affinities=affinities
+            ).partitions
 
     is_cross = total_seqlen_k != total_seqlen_q
     meta_q = DispatchMeta(

@@ -20,12 +20,99 @@ Algorithms (DispatchAlgType):
 
 from __future__ import annotations
 
+import copy
 import heapq
 import random
 from dataclasses import dataclass, field
 
 from ...common.enum import DispatchAlgType
+from ...common.range import AttnRange
+from ...common.ranges import AttnRanges
 from ...config import DispatchConfig  # canonical definition (config.py)
+
+
+class BaseDispatchAffinity:
+    """Chunk/bucket affinity for tie-breaking rank selection
+    (ref dispatch_solver.py:373). Smaller distance = stronger pull."""
+
+    def distance_to(self, other: "BaseDispatchAffinity") -> float:
+        raise NotImplementedError
+
+    def update(self, other: "BaseDispatchAffinity") -> None:
+        """Absorb ``other`` (in-place) after assigning its chunk here."""
+        raise NotImplementedError
+
+    def closest_idx(self, others: list["BaseDispatchAffinity"]) -> int:
+        return min(range(len(others)), key=lambda i: self.distance_to(others[i]))
+
+
+class SampleIDAffinity(BaseDispatchAffinity):
+    """Counts of sample ids in a chunk/bucket (ref :416): chunks from the
+    same packed sample prefer the same rank, so sample-local kv stays
+    rank-local."""
+
+    def __init__(self) -> None:
+        self.counts: dict[int, int] = {}
+
+    @staticmethod
+    def from_list(ids: list[int]) -> "SampleIDAffinity":
+        a = SampleIDAffinity()
+        for i in ids:
+            a.add_sample_id(i)
+        return a
+
+    def add_sample_id(self, sample_id: int) -> None:
+        assert sample_id >= 0
+        self.counts[sample_id] = self.counts.get(sample_id, 0) + 1
+
+    def get_count(self, sample_id: int) -> int:
+        return self.counts.get(sample_id, 0)
+
+    def is_empty(self) -> bool:
+        return not self.counts
+
+    def distance_to(self, other: "SampleIDAffinity") -> float:
+        """Negative count, in ``other``, of self's majority sample id."""
+        if self.is_empty() or other.is_empty():
+            return 0.0
+        major = max(self.counts, key=lambda i: self.counts[i])
+        return -float(other.get_count(major))
+
+    def update(self, other: "SampleIDAffinity") -> None:
+        for i, c in other.counts.items():
+            self.counts[i] = self.counts.get(i, 0) + c
+
+    def __repr__(self) -> str:
+        return f"SampleIDAffinity({self.counts})"
+
+
+class IOUAffinity(BaseDispatchAffinity):
+    """KV-coverage overlap affinity (ref :478): chunks whose attention
+    touches overlapping k ranges co-locate, deduplicating remote-kv fetches
+    (the GroupCast volume shrinks by exactly the intersection)."""
+
+    def __init__(self) -> None:
+        self.iou_ranges = AttnRanges()
+
+    @staticmethod
+    def from_ranges(ranges: AttnRanges) -> "IOUAffinity":
+        a = IOUAffinity()
+        a.iou_ranges = ranges.merge()
+        return a
+
+    def distance_to(self, other: "IOUAffinity") -> float:
+        return -float(self.iou_ranges.intersect_size_with(other.iou_ranges))
+
+    def update(self, other: "IOUAffinity") -> None:
+        merged = AttnRanges()
+        for r in self.iou_ranges:
+            merged.append(AttnRange(r.start, r.end))
+        for r in other.iou_ranges:
+            merged.append(AttnRange(r.start, r.end))
+        self.iou_ranges = merged.merge()
+
+    def __repr__(self) -> str:
+        return f"IOUAffinity({self.iou_ranges})"
 
 
 @dataclass
@@ -52,6 +139,7 @@ class DispatchSolver:
         cp_size: int,
         sample_ids: list[int] | None = None,
         seed: int = 0,
+        affinities: list[BaseDispatchAffinity] | None = None,
     ) -> DispatchSolution:
         n = len(areas)
         lb = self._lower_bound(areas, cp_size)
@@ -88,7 +176,11 @@ class DispatchSolver:
         elif alg == DispatchAlgType.MIN_HEAP:
             parts = self._min_heap(areas, cp_size, k)
         elif alg in (DispatchAlgType.TOPP_HEAP, DispatchAlgType.BATCH_TOPP_HEAP):
-            parts = self._topp_heap(areas, cp_size, k, seed)
+            if affinities is None and sample_ids is not None:
+                affinities = [
+                    SampleIDAffinity.from_list([i]) for i in sample_ids
+                ]
+            parts = self._topp_heap(areas, cp_size, k, seed, affinities)
         elif alg == DispatchAlgType.BINARY_SEARCH:
             parts = self._binary_search(areas, cp_size, k)
         elif alg == DispatchAlgType.DYNAMIC_PROGRAMMING:
@@ -203,22 +295,43 @@ class DispatchSolver:
         return parts
 
     def _topp_heap(
-        self, areas: list[int], cp: int, k: int, seed: int
+        self,
+        areas: list[int],
+        cp: int,
+        k: int,
+        seed: int,
+        affinities: list[BaseDispatchAffinity] | None = None,
     ) -> list[list[int]]:
-        """MIN_HEAP with randomized selection among the top-p least-loaded
-        candidate ranks — decorrelates adjacent chunks across ranks, which
-        lowers duplicate-kv comm (the reference's IOU-affinity motivation)."""
+        """MIN_HEAP with selection among the top-p least-loaded candidate
+        ranks: affinity-closest when chunk affinities are given (the
+        reference's IOU / sample-id tie-break), seeded-random otherwise."""
         rng = random.Random(seed)
         order = sorted(range(len(areas)), key=lambda i: -areas[i])
         loads = [0] * cp
         parts: list[list[int]] = [[] for _ in range(cp)]
         pool_size = max(1, int(cp * self.config.top_p))
+        rank_aff: list[BaseDispatchAffinity | None] = [None] * cp
         for i in order:
             candidates = sorted(
                 (r for r in range(cp) if len(parts[r]) < k),
                 key=lambda r: loads[r],
             )[:pool_size]
-            r = rng.choice(candidates)
+            if affinities is not None:
+                aff = affinities[i]
+                best = min(
+                    candidates,
+                    key=lambda r: (
+                        0.0 if rank_aff[r] is None
+                        else aff.distance_to(rank_aff[r])
+                    ),
+                )
+                r = best
+                if rank_aff[r] is None:
+                    rank_aff[r] = copy.deepcopy(aff)
+                else:
+                    rank_aff[r].update(aff)
+            else:
+                r = rng.choice(candidates)
             parts[r].append(i)
             loads[r] += areas[i]
         return parts

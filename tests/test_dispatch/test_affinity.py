@@ -1,0 +1,71 @@
+"""Dispatch affinity tests (ref dispatch_solver.py:373-520)."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common.range import AttnRange
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.common.enum import DispatchAlgType
+from magiattention_tpu.config import DispatchConfig
+from magiattention_tpu.meta.solver.dispatch_solver import (
+    DispatchSolver,
+    IOUAffinity,
+    SampleIDAffinity,
+)
+
+
+def test_sample_id_affinity_semantics():
+    a = SampleIDAffinity.from_list([0, 0, 1])
+    b = SampleIDAffinity.from_list([0, 2])
+    c = SampleIDAffinity.from_list([3])
+    # a's majority id (0) appears once in b, never in c
+    assert a.distance_to(b) == -1
+    assert a.distance_to(c) == 0
+    assert a.closest_idx([c, b]) == 1
+    a.update(b)
+    assert a.get_count(0) == 3 and a.get_count(2) == 1
+
+
+def test_iou_affinity_semantics():
+    a = IOUAffinity.from_ranges(AttnRanges([AttnRange(0, 100)]))
+    b = IOUAffinity.from_ranges(AttnRanges([AttnRange(50, 150)]))
+    c = IOUAffinity.from_ranges(AttnRanges([AttnRange(200, 300)]))
+    assert a.distance_to(b) == -50
+    assert a.distance_to(c) == 0
+    assert a.closest_idx([c, b]) == 1
+    a.update(b)
+    assert a.iou_ranges.total_seqlen == 150  # merged [0,150)
+
+
+def test_topp_heap_groups_same_sample_chunks():
+    # 8 chunks, 2 samples interleaved, equal areas: with sample affinity the
+    # solver should co-locate same-sample chunks far better than random
+    areas = [10] * 8
+    sample_ids = [0, 1, 0, 1, 0, 1, 0, 1]
+    solver = DispatchSolver(
+        alg=DispatchAlgType.TOPP_HEAP,
+        config=DispatchConfig(alg=DispatchAlgType.TOPP_HEAP, top_p=1.0),
+    )
+    sol = solver.solve(areas, 2, sample_ids=sample_ids)
+    for part in sol.partitions:
+        ids = {sample_ids[i] for i in part}
+        assert len(ids) == 1, sol.partitions  # pure per-sample ranks
+
+
+def test_topp_heap_iou_affinity_colocates_overlap():
+    # chunks 0-3 share kv range A, chunks 4-7 share kv range B
+    areas = [10] * 8
+    affs = [
+        IOUAffinity.from_ranges(
+            AttnRanges([AttnRange(0, 100) if i < 4 else AttnRange(100, 200)])
+        )
+        for i in range(8)
+    ]
+    solver = DispatchSolver(
+        alg=DispatchAlgType.TOPP_HEAP,
+        config=DispatchConfig(alg=DispatchAlgType.TOPP_HEAP, top_p=1.0),
+    )
+    sol = solver.solve(areas, 2, affinities=affs)
+    for part in sol.partitions:
+        groups = {0 if i < 4 else 1 for i in part}
+        assert len(groups) == 1, sol.partitions
