@@ -59,10 +59,14 @@ def magi_attn_flex_key(
     *,
     mesh: Mesh,
     cp_axis: str = "cp",
+    head_axis: str | None = None,
     chunk_size: int | None = None,
     dist_attn_config: DistAttnConfig | None = None,
 ) -> DistAttnRuntimeKey:
     """Plan a flexible-mask distributed attention; returns the runtime key.
+
+    ``head_axis`` (optional) names a mesh axis to tensor-parallel-shard the
+    head dimension over — attention runs TP x CP in one shard_map.
 
     The mask is ``(q_ranges, k_ranges, attn_mask_type)`` slice metadata in
     global coordinates (ref :442). ``total_seqlen_q`` must be pre-padded to
@@ -105,6 +109,7 @@ def magi_attn_flex_key(
         chunk_size=chunk_size,
         cp_size=cp_size,
         cp_axis=cp_axis,
+        head_axis=head_axis,
         mesh_sig=_mesh_signature(mesh),
         config=config,
         env_snapshot=snapshot_env(),
@@ -118,13 +123,15 @@ def magi_attn_varlen_key(
     cu_seqlens_q: Sequence[int],
     cu_seqlens_k: Sequence[int] | None = None,
     *,
-    causal: bool = True,
+    causal: bool = False,
     mesh: Mesh,
     cp_axis: str = "cp",
+    head_axis: str | None = None,
     chunk_size: int | None = None,
     dist_attn_config: DistAttnConfig | None = None,
 ) -> DistAttnRuntimeKey:
-    """Varlen (cu_seqlens) convenience wrapper (ref :160)."""
+    """Varlen (cu_seqlens) convenience wrapper (ref :160; causal defaults
+    False, matching the reference and the re-key variant)."""
     q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
         cu_seqlens_q, cu_seqlens_k, causal
     )
@@ -136,6 +143,7 @@ def magi_attn_varlen_key(
         total_seqlen_k=k_ranges.end,
         mesh=mesh,
         cp_axis=cp_axis,
+        head_axis=head_axis,
         chunk_size=chunk_size,
         dist_attn_config=dist_attn_config,
     )
@@ -181,6 +189,7 @@ def make_flex_key_for_new_mask_after_dispatch(
         chunk_size=old.chunk_size,
         cp_size=old.cp_size,
         cp_axis=old.cp_axis,
+        head_axis=old.head_axis,
         mesh_sig=old.mesh_sig,
         config=dist_attn_config or old.config,
         env_snapshot=snapshot_env(),

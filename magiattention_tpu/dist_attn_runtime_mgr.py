@@ -49,6 +49,7 @@ class DistAttnRuntimeKey:
     chunk_size: int
     cp_size: int
     cp_axis: str | tuple[str, str]
+    head_axis: str | None
     mesh_sig: tuple
     config: DistAttnConfig
     env_snapshot: tuple
@@ -115,6 +116,7 @@ class DistAttnRuntimeMgr:
             calc_meta=self.calc_meta,
             mesh=mesh,
             cp_axis=key.cp_axis,
+            head_axis=key.head_axis,
             # auto (overlap iff the solver produced >1 stage) when enabled,
             # forced single merged kernel when disabled
             use_overlap=None if overlap_cfg.enable else False,
@@ -164,7 +166,6 @@ class DistAttnRuntimeMgr:
         ref_xattn_q_ranges: AttnRanges,
         ref_xattn_k_ranges: AttnRanges,
         attn_mask_type=None,
-        return_host_only: bool = True,
     ) -> Any:
         """Cross-attention args for the dispatched q layout (ref :269-357).
 
@@ -174,10 +175,8 @@ class DistAttnRuntimeMgr:
         coordinates. Only FULL masks are supported (ref asserts the same).
 
         Returns:
-            ``return_host_only=True``: this API is SPMD — returns the
-            rank-stacked list of per-rank :class:`AttnArg` (the caller
-            selects its shard inside shard_map); ``False`` returns the same
-            list (kept for signature parity).
+            The rank-stacked list of per-rank :class:`AttnArg` — this API
+            is SPMD; the caller selects its shard inside shard_map.
         """
         from .common.enum import AttnMaskType as _MT
         from .kernels.mask_utils import BAND_INF

@@ -84,7 +84,6 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     "MAGI_ATTENTION_MIN_CHUNKS_PER_RANK",
     "MAGI_ATTENTION_CPP_BACKEND",
     "MAGI_ATTENTION_PALLAS_INTERPRET",
-    "MAGI_ATTENTION_HIGH_PRECISION_REDUCE",
     "MAGI_ATTENTION_QO_COMM",
     "MAGI_ATTENTION_HIERARCHICAL_COMM",
     "MAGI_ATTENTION_FFA_BLOCK_Q",

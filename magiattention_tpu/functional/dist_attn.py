@@ -166,6 +166,11 @@ class DistAttnRuntime:
     block_q: int | None = None
     block_k: int | None = None
     use_overlap: bool | None = None  # None -> overlap iff >1 stage
+    # tensor parallelism: shard the head dim over this mesh axis (composes
+    # with cp — the reference delegates TP to the host framework, SURVEY
+    # §2.8; on TPU the attention itself runs TP-sharded in the same
+    # shard_map, no host framework needed)
+    head_axis: str | None = None
 
     def __post_init__(self) -> None:
         from ..kernels.ffa import default_blocks
@@ -268,6 +273,16 @@ class DistAttnRuntime:
             )
         return group_cast_rows(x, ops[0][0], ops[1][0], self.cp_axis)
 
+    def _cast_kv(self, k, v, ops):
+        """Fused K|V GroupCast: one collective for both tensors (the
+        reference's asymmetric-KV comm fuses along head_dim the same way,
+        comm_meta.py:588-591 — valid for any d_k/d_v since rows coincide)."""
+        if k.dtype == v.dtype and k.shape[1] == v.shape[1]:
+            kv = jnp.concatenate([k, v], axis=-1)
+            kv_r = self._cast(kv, ops)
+            return kv_r[..., : k.shape[-1]], kv_r[..., k.shape[-1]:]
+        return self._cast(k, ops), self._cast(v, ops)
+
     @property
     def backend(self) -> str:
         """Kernel backend (env-driven; part of the runtime cache key)."""
@@ -300,13 +315,22 @@ class DistAttnRuntime:
         sq, hq, dh = q.shape
         _, hk, dv = v.shape
         group = hq // hk
+        if self.head_axis is not None:
+            tp = self.mesh.shape[self.head_axis]
+            if hq % tp or hk % tp:
+                raise ValueError(
+                    f"head_axis={self.head_axis!r} (size {tp}) must divide "
+                    f"both num_heads_q ({hq}) and num_heads_kv ({hk}) — "
+                    f"GQA kv heads shard over TP too"
+                )
         scale = (
             float(dh) ** -0.5
             if self.softmax_scale is None
             else self.softmax_scale
         )
         axis = self.cp_axis
-        spec = P(axis)
+        # data spec: seq dim over cp, head dim over tp (when given)
+        spec = P(axis, self.head_axis)
 
         if self.backend in ("sdpa", "sdpa_online"):
             # jnp fake-backend path (fp32/fp64-exact distributed testing,
@@ -321,8 +345,9 @@ class DistAttnRuntime:
             def f(q, k, v, cast_ops, slices):
                 parts_k, parts_v = [k], [v]
                 for ops in cast_ops:
-                    parts_k.append(self._cast(k, ops))
-                    parts_v.append(self._cast(v, ops))
+                    kr, vr = self._cast_kv(k, v, ops)
+                    parts_k.append(kr)
+                    parts_v.append(vr)
                 k_all = jnp.concatenate(parts_k, axis=0)
                 v_all = jnp.concatenate(parts_v, axis=0)
                 qr, kr, lo, hi = (a[0] for a in slices)
@@ -350,8 +375,9 @@ class DistAttnRuntime:
             def f(q, k, v, cast_ops, arrays):
                 kv_parts_k, kv_parts_v = [k], [v]
                 for ops in cast_ops:
-                    kv_parts_k.append(self._cast(k, ops))
-                    kv_parts_v.append(self._cast(v, ops))
+                    kr, vr = self._cast_kv(k, v, ops)
+                    kv_parts_k.append(kr)
+                    kv_parts_v.append(vr)
                 k_all = jnp.concatenate(kv_parts_k, axis=0)
                 v_all = jnp.concatenate(kv_parts_v, axis=0)
                 local_arrays = tuple(a[0] for a in arrays)
@@ -383,8 +409,9 @@ class DistAttnRuntime:
             # compute, XLA overlaps them with the host + earlier-stage kernels
             ks, vs = [k], [v]
             for ops in cast_ops:
-                ks.append(self._cast(k, ops))
-                vs.append(self._cast(v, ops))
+                kr, vr = self._cast_kv(k, v, ops)
+                ks.append(kr)
+                vs.append(vr)
             arrays_list = (tuple(a[0] for a in host_arrays),) + tuple(
                 tuple(a[0] for a in sa) for sa in stage_arrays
             )

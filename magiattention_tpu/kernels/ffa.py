@@ -36,7 +36,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..env import general as env_general
 from ..env import kernel as env_kernel
-from .ffa_plan import (
+from .ffa_plan import (  # noqa: F401
+    IS_FULL,
     DHI,
     DLO,
     IS_FIRST,
@@ -149,15 +150,22 @@ def _fwd_kernel(
     ) * scale
     if softcap > 0.0:
         s = softcap * jnp.tanh(s / softcap)
-    mask = _item_mask(meta_ref, w, q_base, k_base, bq, bk)
-    s = jnp.where(mask, s, NEG_INF)
+    # interior (fully-unmasked) tiles skip the mask build + select entirely
+    # — the TPU analogue of the reference schedulers' full-tile fast path
+    s = jax.lax.cond(
+        meta_ref[w, IS_FULL] == 1,
+        lambda s: s,
+        lambda s: jnp.where(
+            _item_mask(meta_ref, w, q_base, k_base, bq, bk), s, NEG_INF
+        ),
+        s,
+    )
 
     m_prev = m_scr[:, :1]  # (bq, 1)
     m_blk = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_blk)
     m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-    p = jnp.exp(s - m_safe)
-    p = jnp.where(mask, p, 0.0)
+    p = jnp.exp(s - m_safe)  # exp(-inf - finite) == 0: no re-masking needed
     alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev = -inf, m_safe finite
     alpha = jnp.where(jnp.isneginf(m_prev) & jnp.isneginf(m_new), 0.0, alpha)
 
@@ -294,13 +302,20 @@ def _bwd_dq_kernel(
     else:
         sc = s
         dcap = None
-    mask = _item_mask(meta_ref, w, q_base, k_base, bq, bk)
+    sm = jax.lax.cond(
+        meta_ref[w, IS_FULL] == 1,
+        lambda s: s,
+        lambda s: jnp.where(
+            _item_mask(meta_ref, w, q_base, k_base, bq, bk), s, NEG_INF
+        ),
+        sc,
+    )
 
     lse = lse_ref[:, 0]  # (bq,) f32
     neg = jnp.isneginf(lse)
     lse_safe = jnp.where(neg, 0.0, lse)
-    p = jnp.exp(jnp.where(mask, sc, NEG_INF) - lse_safe[:, None])
-    p = jnp.where(mask & ~neg[:, None], p, 0.0)
+    p = jnp.exp(sm - lse_safe[:, None])
+    p = jnp.where(neg[:, None], 0.0, p)  # uncovered rows contribute nothing
 
     dp = jax.lax.dot_general(
         do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -416,13 +431,21 @@ def _bwd_dkv_kernel(
     else:
         sc_t = s_t
         dcap_t = None
-    mask_t = _item_mask(meta_ref, w, q_base, k_base, bq, bk, transposed=True)
+    sm_t = jax.lax.cond(
+        meta_ref[w, IS_FULL] == 1,
+        lambda s: s,
+        lambda s: jnp.where(
+            _item_mask(meta_ref, w, q_base, k_base, bq, bk, transposed=True),
+            s, NEG_INF,
+        ),
+        sc_t,
+    )
 
     lse = lse_ref[:, 0]  # (bq,)
     neg = jnp.isneginf(lse)
     lse_safe = jnp.where(neg, 0.0, lse)
-    p_t = jnp.exp(jnp.where(mask_t, sc_t, NEG_INF) - lse_safe[None, :])
-    p_t = jnp.where(mask_t & ~neg[None, :], p_t, 0.0)
+    p_t = jnp.exp(sm_t - lse_safe[None, :])
+    p_t = jnp.where(neg[None, :], 0.0, p_t)
 
     dv_scr[:] += jax.lax.dot_general(
         p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),

@@ -77,17 +77,33 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
     }
 
 
-def shard_params(params: dict, mesh: Mesh, axis: str = "cp") -> dict:
-    """ZeRO-3-style: shard every matrix's first dim over the cp axis."""
+def shard_params(
+    params: dict, mesh: Mesh, axis: str = "cp", tp_axis: str | None = None
+) -> dict:
+    """ZeRO-3-style first-dim sharding over the dp/cp axis; with ``tp_axis``
+    the attention/MLP projections additionally Megatron-shard their
+    column/row dims over TP (wq/wk/wv/w_gate/w_up column-parallel, wo/w_down
+    row-parallel)."""
+    tp = mesh.shape[tp_axis] if tp_axis else 1
 
-    def s(x):
-        if x.ndim >= 2 and x.shape[0] % mesh.shape[axis] == 0:
-            return jax.device_put(
-                x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
-            )
-        return jax.device_put(x, NamedSharding(mesh, P()))
+    def s2(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
 
-    return jax.tree.map(s, params)
+    def s(path, x):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        dp_ok = x.ndim >= 2 and x.shape[0] % mesh.shape[axis] == 0
+        d0 = axis if dp_ok else None
+        if tp_axis and x.ndim == 2:
+            if name in ("wq", "wk", "wv", "w_gate", "w_up") and x.shape[1] % tp == 0:
+                return s2(x, P(d0, tp_axis))
+            if name in ("wo", "w_down") and x.shape[0] % (mesh.shape[axis] * tp if dp_ok else tp) == 0:
+                # row-parallel: input dim over tp (stacked with dp when legal)
+                return s2(x, P((axis, tp_axis) if dp_ok else tp_axis, None))
+        if dp_ok:
+            return s2(x, P(axis, *([None] * (x.ndim - 1))))
+        return s2(x, P())
+
+    return jax.tree_util.tree_map_with_path(s, params)
 
 
 def _rms_norm(x, w, eps):

@@ -1,0 +1,51 @@
+"""Plan/kernel pre-warming (ref: magi_attention/testing/precompile.py).
+
+The reference pre-JITs CUDA kernels before spawning distributed test
+processes. The TPU analogue warms the two host caches that dominate first
+-call latency — the FFA tile-plan LRU and jax's jit cache — for a list of
+(mask, shape) configurations, so timed or distributed test bodies hit warm
+caches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def precompile_ffa(
+    configs: list[dict],
+    dtype=None,
+) -> int:
+    """Warm plan + jit caches for each config.
+
+    Each config: ``{"q_ranges", "k_ranges", "attn_type_map", "seqlen_q",
+    "seqlen_k", "num_heads_q", "num_heads_kv", "head_dim"}`` (ranges as
+    (N, 2) arrays).
+
+    Returns the number of configs warmed.
+    """
+    import jax.numpy as jnp
+
+    from ..kernels.ffa import ffa_attn
+
+    if dtype is None:
+        dtype = jnp.float32
+    n = 0
+    for cfg in configs:
+        sq, sk = cfg["seqlen_q"], cfg["seqlen_k"]
+        hq = cfg.get("num_heads_q", 2)
+        hk = cfg.get("num_heads_kv", 1)
+        d = cfg.get("head_dim", 64)
+        q = jnp.zeros((sq, hq, d), dtype)
+        k = jnp.zeros((sk, hk, d), dtype)
+        v = jnp.zeros((sk, hk, d), dtype)
+        out, _ = ffa_attn(
+            q, k, v,
+            np.asarray(cfg["q_ranges"], np.int32),
+            np.asarray(cfg["k_ranges"], np.int32),
+            np.asarray(cfg.get("attn_type_map"), np.int32)
+            if cfg.get("attn_type_map") is not None else None,
+        )
+        out.block_until_ready()
+        n += 1
+    return n

@@ -1,0 +1,66 @@
+"""Overlap-safety assertion (ref: magi_attention/testing/template.py:77).
+
+The reference stress-runs a kernel against a concurrent NCCL overlay stream
+to catch compute/comm data races. On TPU there are no user-visible streams
+— XLA owns the schedule — so the corresponding hazard is a *plan* bug: the
+multi-stage overlapped program reading a receive buffer before its
+collective completes would manifest as a numerical mismatch between the
+overlapped and the blocking (no-overlap, single merged kernel) executions
+of the same mask. ``assert_overlap_safe`` runs both and demands agreement,
+which also exercises XLA's async-collective scheduling on the overlapped
+program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.dist_attn import DistAttnRuntime
+from .precision import assert_close
+
+
+def assert_overlap_safe(
+    comm_meta,
+    calc_meta,
+    mesh,
+    cp_axis,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    atol: float = 1e-5,
+    rtol: float = 1e-5,
+    iters: int = 3,
+) -> None:
+    """Assert the overlapped CP program matches the blocking one.
+
+    Args:
+        comm_meta/calc_meta: a solved plan with >= 1 remote stage.
+        q/k/v: dispatched tensors sharded over the cp axis.
+        iters: repetitions (the reference stress-loops; XLA is
+            deterministic, so this guards against nondeterministic
+            scheduling regressions rather than races).
+    """
+    overlapped = DistAttnRuntime(
+        comm_meta=comm_meta, calc_meta=calc_meta, mesh=mesh, cp_axis=cp_axis,
+        use_overlap=True,
+    )
+    blocking = DistAttnRuntime(
+        comm_meta=comm_meta, calc_meta=calc_meta, mesh=mesh, cp_axis=cp_axis,
+        use_overlap=False,
+    )
+    f_o = jax.jit(overlapped.calc_attn)
+    f_b = jax.jit(blocking.calc_attn)
+    out_ref, lse_ref = f_b(q, k, v)
+    for i in range(iters):
+        out, lse = f_o(q, k, v)
+        assert_close(
+            out, out_ref, atol=atol, rtol=rtol, norm_rtol=rtol,
+            msg=f"overlap-safety iter {i}: out mismatch",
+        )
+        assert_close(
+            jnp.where(jnp.isneginf(lse), 0.0, lse),
+            jnp.where(jnp.isneginf(lse_ref), 0.0, lse_ref),
+            atol=atol, rtol=rtol, norm_rtol=rtol,
+            msg=f"overlap-safety iter {i}: lse mismatch",
+        )

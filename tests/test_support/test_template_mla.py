@@ -1,0 +1,139 @@
+"""Overlap-safety template, precompile, MLA asymmetric head dims, and
+determinism (ref: testing/template.py:77, precompile.py; comm_meta MLA
+support :588; MAGI_ATTENTION_DETERMINISTIC_MODE)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from magiattention_tpu.api import (
+    calc_attn,
+    dispatch,
+    magi_attn_flex_key,
+    undispatch,
+)
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.config import DistAttnConfig, OverlapConfig
+from magiattention_tpu.testing import (
+    assert_close,
+    assert_overlap_safe,
+    precompile_ffa,
+    ref_attn,
+)
+
+S, H, HK, D = 256, 2, 1, 32
+CHUNK = 16
+
+
+def _mesh(cp=4):
+    return Mesh(np.array(jax.devices("cpu")[:cp]), axis_names=("cp",))
+
+
+def _dispatched_inputs(key, dv=D, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, dv)), dtype=jnp.float32)
+    return q, k, v
+
+
+def test_assert_overlap_safe_on_real_plan():
+    from magiattention_tpu.api.magi_attn_interface import _mgr
+
+    mesh = _mesh()
+    cfg = DistAttnConfig(overlap_config=OverlapConfig(degree=2))
+    key = magi_attn_flex_key(
+        [[0, S]], [[0, S]], [1], S, S, mesh=mesh, cp_axis="cp",
+        chunk_size=CHUNK, dist_attn_config=cfg,
+    )
+    mgr = _mgr(key)
+    q, k, v = _dispatched_inputs(key)
+    qd = dispatch(q, key)
+    kd = dispatch(k, key, role="kv")
+    vd = dispatch(v, key, role="kv")
+    assert_overlap_safe(
+        mgr.comm_meta, mgr.calc_meta, mesh, "cp", qd, kd, vd
+    )
+
+
+def test_precompile_warms_caches():
+    n = precompile_ffa([
+        dict(q_ranges=[[0, 128]], k_ranges=[[0, 128]], attn_type_map=[1],
+             seqlen_q=128, seqlen_k=128),
+        dict(q_ranges=[[0, 64], [64, 128]], k_ranges=[[0, 64], [64, 128]],
+             attn_type_map=[0, 0], seqlen_q=128, seqlen_k=128),
+    ])
+    assert n == 2
+
+
+def test_mla_asymmetric_head_dims_pipeline():
+    """d_v != d_qk (MLA-style) through the full CP pipeline."""
+    DV = 64
+    mesh = _mesh()
+    key = magi_attn_flex_key(
+        [[0, S]], [[0, S]], [1], S, S, mesh=mesh, cp_axis="cp",
+        chunk_size=CHUNK,
+    )
+    q, k, v = _dispatched_inputs(key, dv=DV)
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges([[0, S]]), AttnRanges.from_ranges([[0, S]]),
+        [AttnMaskType.CAUSAL], total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+
+    def fwd(q, k, v):
+        qd = dispatch(q, key)
+        kd = dispatch(k, key, role="kv")
+        vd = dispatch(v, key, role="kv")
+        od, _ = calc_attn(qd, kd, vd, key)
+        return undispatch(od, key)
+
+    out = jax.jit(fwd)(q, k, v)
+    assert out.shape == (S, H, DV)
+    out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
+                 msg="MLA dv=64 out")
+
+    # backward too (fused K|V cast path must split grads exactly)
+    w = jnp.asarray(
+        np.random.default_rng(1).standard_normal((S, H, DV)),
+        dtype=jnp.float32,
+    )
+    g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(fwd(q, k, v) * w), argnums=(0, 1, 2)
+    ))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            ref_attn(q, k, v, mask, compute_dtype=jnp.float32)[0] * w
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), g, g_ref):
+        assert_close(a, b, atol=1e-3, rtol=1e-3, norm_rtol=3e-4,
+                     msg=f"MLA {name}")
+
+
+def test_deterministic_repeat_runs_bitwise_identical():
+    """XLA + fixed merge order: repeated runs are bitwise identical (the
+    deterministic-mode guarantee is unconditional on TPU)."""
+    mesh = _mesh()
+    key = magi_attn_flex_key(
+        [[0, S]], [[0, S]], [1], S, S, mesh=mesh, cp_axis="cp",
+        chunk_size=CHUNK,
+    )
+    q, k, v = _dispatched_inputs(key)
+
+    def fwd(q, k, v):
+        qd = dispatch(q, key)
+        kd = dispatch(k, key, role="kv")
+        vd = dispatch(v, key, role="kv")
+        od, _ = calc_attn(qd, kd, vd, key)
+        return undispatch(od, key)
+
+    f = jax.jit(fwd)
+    a = np.asarray(f(q, k, v))
+    b = np.asarray(f(q, k, v))
+    np.testing.assert_array_equal(a, b)
