@@ -6,6 +6,11 @@ Metric: attention TFLOP/s for bf16 causal self-attention, seq=4096, hq=16,
 hk=8 (GQA), d=128, fwd+bwd (FLOPs = 4*area*d*hq fwd + 2.5x bwd, the
 reference's counting — docs/source/blog/cp_benchmark.md:35-58).
 
+Timing: the train step is chained inside one jit via lax.scan
+(benchmarking.do_bench_scan) so per-dispatch RPC overhead on the tunneled
+device amortizes away and the carried data dependence defeats memoization;
+falls back to the chained-dispatch loop if the scan path fails to compile.
+
 vs_baseline: achieved MFU divided by 0.5 — the reference's headline claim is
 "FFA has MFU comparable to FA3" (README.md:69) and FA3-class kernels sit
 around 50% MFU on their native hardware, so 1.0 means FA3-class efficiency
@@ -23,6 +28,7 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from magiattention_tpu.benchmarking.bench import do_bench_scan
     from magiattention_tpu.kernels.ffa import ffa_attn
 
     S, HQ, HK, D = 4096, 16, 8, 128
@@ -42,26 +48,35 @@ def main() -> int:
     tm = np.array([1], dtype=np.int32)  # causal
 
     def loss(q, k, v):
-        o, _ = ffa_attn(q, k, v, qr, kr, tm)
+        o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=512, block_k=1024)
         return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
 
-    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-    g = step(q, k, v)
-    jax.block_until_ready(g)
+    grad = jax.grad(loss, argnums=(0, 1, 2))
 
-    iters = 10 if backend != "cpu" else 1
-    # perturb q each iter so no layer of the stack can memoize results
-    qs = [q * (1.0 + 1e-3 * i) for i in range(iters)]
-    jax.block_until_ready(qs)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        g = step(qs[i], k, v)
-    jax.block_until_ready(g)
-    dt = (time.perf_counter() - t0) / iters
+    def body(q):
+        g = grad(q, k, v)
+        return (q + 1e-3 * g[0].astype(dtype)).astype(dtype)
+
+    try:
+        if backend == "cpu":
+            raise RuntimeError("interpret mode: skip scan timing")
+        dt_ms = do_bench_scan(body, q, length=6, reps=2)
+    except Exception:
+        # fallback: chained dispatches (serial data dependence)
+        step = jax.jit(body)
+        qq = step(q)
+        qq.block_until_ready()
+        iters = 8 if backend != "cpu" else 1
+        t0 = time.perf_counter()
+        qq = q
+        for _ in range(iters):
+            qq = step(qq)
+        float(jnp.sum(qq.astype(jnp.float32)))
+        dt_ms = (time.perf_counter() - t0) / iters * 1e3
 
     area = S * (S + 1) // 2
     flops = 4 * area * D * HQ * 3.5  # fwd + 2.5x bwd
-    tflops = flops / dt / 1e12
+    tflops = flops / (dt_ms * 1e-3) / 1e12
     peak = 394.0  # v5e bf16 peak TFLOP/s
     mfu = tflops / peak
     vs_baseline = mfu / 0.5

@@ -52,6 +52,40 @@ def do_bench_mem(
     return ms, bytes_moved / (ms * 1e-3) / 1e9
 
 
+def do_bench_scan(
+    body: Callable[[Any], Any],
+    carry0: Any,
+    length: int = 8,
+    reps: int = 3,
+) -> float:
+    """Per-iteration ms of ``body`` chained ``length`` times inside ONE jit
+    via ``lax.scan`` — the robust timing mode on remote-tunneled devices:
+    per-dispatch RPC overhead amortizes over the scan, and the carried data
+    dependence defeats any memoization layer. ``body`` must map carry ->
+    carry of identical shape/dtype."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(c):
+        def f(c, _):
+            return body(c), None
+        c, _ = jax.lax.scan(f, c, None, length=length)
+        return c
+
+    out = run(carry0)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(carry0)
+        jax.block_until_ready(out)
+        # force a real value fetch (block_until_ready alone can return
+        # before remote execution on tunneled backends)
+        jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0].item()
+        best = min(best, (time.perf_counter() - t0) / length * 1e3)
+    return best
+
+
 @dataclass
 class Benchmark:
     """Declarative sweep spec (ref Benchmark/Mark :372)."""
