@@ -1,15 +1,18 @@
 """Benchmark: FFA Pallas kernel fwd+bwd throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Metric: attention TFLOP/s for bf16 causal self-attention, seq=4096, hq=16,
 hk=8 (GQA), d=128, fwd+bwd (FLOPs = 4*area*d*hq fwd + 2.5x bwd, the
 reference's counting — docs/source/blog/cp_benchmark.md:35-58).
 
-Timing: the train step is chained inside one jit via lax.scan
-(benchmarking.do_bench_scan) so per-dispatch RPC overhead on the tunneled
-device amortizes away and the carried data dependence defeats memoization;
-falls back to the chained-dispatch loop if the scan path fails to compile.
+Robustness: the TPU backend behind the tunnel is flaky — init can hang for
+minutes or die with UNAVAILABLE. The parent process therefore NEVER imports
+jax; it launches the measurement in a subprocess with a hard timeout and a
+bounded retry loop, and on final failure emits a JSON line with an "error"
+field (rc stays 0) instead of crashing the round. The last attempt falls back
+to JAX_PLATFORMS=cpu (interpret mode, tiny shape) so a degraded number is
+always recorded with its backend labeled.
 
 vs_baseline: achieved MFU divided by 0.5 — the reference's headline claim is
 "FFA has MFU comparable to FA3" (README.md:69) and FA3-class kernels sit
@@ -18,13 +21,28 @@ on this chip. TPU v5e peak bf16 = 394 TFLOP/s.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+ATTEMPTS = 3  # per VERDICT r1: bounded retry with subprocess isolation
+WORKER_TIMEOUT_S = 420  # backend init (~minutes when flaky) + first compile
 
 
-def main() -> int:
+def _emit(obj) -> int:
+    print(json.dumps(obj))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# worker: the actual measurement (runs in a subprocess)
+# ---------------------------------------------------------------------------
+
+
+def run_worker() -> int:
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
@@ -38,6 +56,9 @@ def main() -> int:
         # interpret-mode fallback (no TPU attached): tiny shape, still emits
         S, HQ, HK, D = 512, 4, 2, 64
 
+    block_q = int(os.environ.get("MAGI_BENCH_BLOCK_Q", "512"))
+    block_k = int(os.environ.get("MAGI_BENCH_BLOCK_K", "1024"))
+
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=dtype)
     k = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=dtype)
@@ -48,7 +69,7 @@ def main() -> int:
     tm = np.array([1], dtype=np.int32)  # causal
 
     def loss(q, k, v):
-        o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=512, block_k=1024)
+        o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=block_q, block_k=block_k)
         return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
 
     grad = jax.grad(loss, argnums=(0, 1, 2))
@@ -57,12 +78,15 @@ def main() -> int:
         g = grad(q, k, v)
         return (q + 1e-3 * g[0].astype(dtype)).astype(dtype)
 
+    timing_mode = "scan"
     try:
         if backend == "cpu":
-            raise RuntimeError("interpret mode: skip scan timing")
+            raise _FallbackTiming("interpret mode: skip scan timing")
         dt_ms = do_bench_scan(body, q, length=6, reps=2)
-    except Exception:
-        # fallback: chained dispatches (serial data dependence)
+    except Exception as e:
+        # fallback: chained dispatches (serial data dependence). Record why so
+        # a real compile failure in the scan path is visible in the output.
+        timing_mode = f"chained ({type(e).__name__})"
         step = jax.jit(body)
         qq = step(q)
         qq.block_until_ready()
@@ -81,18 +105,63 @@ def main() -> int:
     mfu = tflops / peak
     vs_baseline = mfu / 0.5
 
-    print(
-        json.dumps(
-            {
-                "metric": "ffa_causal_fwd_bwd_seq4096_bf16",
-                "value": round(tflops, 2),
-                "unit": "TFLOP/s",
-                "vs_baseline": round(vs_baseline, 3),
-            }
-        )
+    return _emit(
+        {
+            "metric": "ffa_causal_fwd_bwd_seq4096_bf16",
+            "value": round(tflops, 2),
+            "unit": "TFLOP/s",
+            "vs_baseline": round(vs_baseline, 3),
+            "backend": backend,
+            "timing_mode": timing_mode,
+            "mfu": round(mfu, 4),
+            "block_q": block_q,
+            "block_k": block_k,
+        }
     )
-    return 0
+
+
+class _FallbackTiming(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# parent: subprocess isolation + bounded retry + degraded-output path
+# ---------------------------------------------------------------------------
+
+
+def main() -> int:
+    last_err = ""
+    for attempt in range(ATTEMPTS):
+        env = dict(os.environ)
+        if attempt == ATTEMPTS - 1:
+            # degraded path: a CPU/interpret number beats no number
+            env["JAX_PLATFORMS"] = "cpu"
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                timeout=WORKER_TIMEOUT_S,
+                capture_output=True,
+                text=True,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempt}: worker timed out after {WORKER_TIMEOUT_S}s"
+            continue
+        for line in reversed(p.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                print(line)
+                return 0
+        last_err = f"attempt {attempt}: rc={p.returncode}: " + p.stderr.strip()[-800:]
+    return _emit(
+        {
+            "metric": "ffa_causal_fwd_bwd_seq4096_bf16",
+            "value": 0.0,
+            "unit": "TFLOP/s",
+            "vs_baseline": 0.0,
+            "error": last_err,
+        }
+    )
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run_worker() if "--worker" in sys.argv else main())

@@ -258,9 +258,17 @@ def calc_attn(
     k: jax.Array,
     v: jax.Array,
     key: DistAttnRuntimeKey,
+    return_max_logits: bool = False,
 ) -> tuple[jax.Array, AttnForwardMeta]:
-    """Distributed attention over dispatched q/k/v (ref :1046)."""
-    out, lse = _mgr(key).calc_attn(q, k, v)
+    """Distributed attention over dispatched q/k/v (ref :1046).
+
+    With ``return_max_logits``, ``meta.max_logits`` is the per-head max
+    logit [hq] all-reduced MAX across cp (ref dist_attn.py:550)."""
+    res = _mgr(key).calc_attn(q, k, v, return_max_logits=return_max_logits)
+    if return_max_logits:
+        out, lse, ml = res
+        return out, AttnForwardMeta(lse=lse, max_logits=ml)
+    out, lse = res
     return out, AttnForwardMeta(lse=lse)
 
 

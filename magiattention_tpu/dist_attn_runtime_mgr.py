@@ -94,6 +94,20 @@ class DistAttnRuntimeMgr:
             from .functional.dynamic_dist_attn import DynamicDistAttnRuntime
             from .meta._make_attn_meta import make_dynamic_attn_plan
 
+            # the dynamic runtime supports neither TP head sharding nor
+            # hierarchical comm yet — fail loudly instead of silently
+            # dropping the requested config
+            if key.head_axis is not None:
+                raise NotImplementedError(
+                    "MAGI_ATTENTION_QO_COMM=1 does not support head_axis "
+                    "(TP head sharding) yet; unset one of the two"
+                )
+            if env_comm.is_hierarchical_comm_enable():
+                raise NotImplementedError(
+                    "MAGI_ATTENTION_QO_COMM=1 does not support "
+                    "MAGI_ATTENTION_HIERARCHICAL_COMM=1 yet; unset one"
+                )
+
             self.dynamic_plan = make_dynamic_attn_plan(
                 q_ranges, k_ranges, mask_types,
                 self.dispatch_meta_q, key.config,
@@ -145,9 +159,15 @@ class DistAttnRuntimeMgr:
         )
 
     def calc_attn(
-        self, q: jax.Array, k: jax.Array, v: jax.Array
-    ) -> tuple[jax.Array, jax.Array]:
-        return self.runtime.calc_attn(q, k, v)
+        self,
+        q: jax.Array,
+        k: jax.Array,
+        v: jax.Array,
+        return_max_logits: bool = False,
+    ):
+        return self.runtime.calc_attn(
+            q, k, v, return_max_logits=return_max_logits
+        )
 
     def roll(self, x: jax.Array, shifts: int) -> jax.Array:
         from .functional.roll import roll_func

@@ -58,41 +58,43 @@ def _head_major(x: jax.Array, sp: int) -> jax.Array:
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _multi_ffa(q, ks, vs, arrays_list, params_list):
     """Merged multi-part FFA: part i attends q against (ks[i], vs[i]) with its
-    own plan; partials are lse-merged into one (out, lse).
+    own plan; partials are lse-merged into one (out, lse, max_logits).
 
     The VJP is the distributed-flash identity (ref dist_attn.py bwd loop
     :3561): each part's backward kernel runs against the FINAL merged lse and
     delta = rowsum(do * out_final), which makes per-part dq/dkv contributions
     exact — no gradient flows through the merge weights themselves.
+    max_logits is the elementwise MAX over parts (ref reduce_max_logits,
+    dist_attn.py:550); it is a non-differentiable auxiliary output.
     """
-    out, lse, _, _ = _multi_ffa_impl(q, ks, vs, arrays_list, params_list)
-    return out, lse
+    out, lse, ml, _, _ = _multi_ffa_impl(q, ks, vs, arrays_list, params_list)
+    return out, lse, ml
 
 
 def _multi_ffa_impl(q, ks, vs, arrays_list, params_list):
     outs, lses = [], []
-    qts = []
+    ml = None
     for k, v, arrs, prm in zip(ks, vs, arrays_list, params_list):
         sqp = prm.num_q_tiles * prm.block_q
         skp = prm.num_k_tiles * prm.block_k
         q_t = _head_major(q, sqp)
         k_t = _head_major(k, skp)
         v_t = _head_major(v, skp)
-        out_t, lse_t = _ffa_fwd_pallas(prm, *arrs[:3], q_t, k_t, v_t)
+        out_t, lse_t, ml_p = _ffa_fwd_pallas(prm, *arrs[:3], q_t, k_t, v_t)
         outs.append(out_t.transpose(1, 0, 2)[: q.shape[0]])
         lses.append(lse_t.T[: q.shape[0]])
-        qts.append(q_t)
+        ml = ml_p if ml is None else jnp.maximum(ml, ml_p)
     out, lse = lse_weighted_reduce(jnp.stack(outs), jnp.stack(lses))
-    return out, lse, outs, lses
+    return out, lse, ml, outs, lses
 
 
 def _multi_ffa_fwd(q, ks, vs, arrays_list, params_list):
-    out, lse, _, _ = _multi_ffa_impl(q, ks, vs, arrays_list, params_list)
-    return (out, lse), (q, ks, vs, out, lse, arrays_list)
+    out, lse, ml, _, _ = _multi_ffa_impl(q, ks, vs, arrays_list, params_list)
+    return (out, lse, ml), (q, ks, vs, out, lse, arrays_list)
 
 
 def _multi_ffa_bwd(params_list, res, cts):
-    do, _ = cts  # lse cotangent ignored (auxiliary output)
+    do, _, _ = cts  # lse/max_logits cotangents ignored (auxiliary outputs)
     q, ks, vs, out, lse, arrays_list = res
     sq = q.shape[0]
     delta = jnp.sum(
@@ -300,17 +302,25 @@ class DistAttnRuntime:
         )
 
     def calc_attn(
-        self, q: jax.Array, k: jax.Array, v: jax.Array
-    ) -> tuple[jax.Array, jax.Array]:
+        self,
+        q: jax.Array,
+        k: jax.Array,
+        v: jax.Array,
+        return_max_logits: bool = False,
+    ):
         """Distributed attention over dispatched tensors.
 
         Args:
             q/k/v: ``(cp*shard, h, d)`` dispatched (permuted) layout, sharded
                 over the cp mesh axis on dim 0.
+            return_max_logits: also return the per-head max logit ``[hq]``
+                fp32, all-reduced MAX across the cp axis (ref
+                dist_attn.py:550 reduce_max_logits) — replicated over cp,
+                sharded over head_axis when set.
 
         Returns:
             (out ``(cp*shard, hq, dv)``, lse ``(cp*shard, hq)`` fp32), same
-            sharded layout.
+            sharded layout; plus max_logits when requested.
         """
         sq, hq, dh = q.shape
         _, hk, dv = v.shape
@@ -331,12 +341,16 @@ class DistAttnRuntime:
         axis = self.cp_axis
         # data spec: seq dim over cp, head dim over tp (when given)
         spec = P(axis, self.head_axis)
+        ml_spec = P(self.head_axis)
+        out_specs = (
+            (spec, spec, ml_spec) if return_max_logits else (spec, spec)
+        )
 
         if self.backend in ("sdpa", "sdpa_online"):
             # jnp fake-backend path (fp32/fp64-exact distributed testing,
             # mirroring the reference's sdpa backend strategy): merged concat
             # buffer + dense band-mask replay, AD end-to-end
-            from ..kernels.sdpa import sdpa_attn
+            from ..kernels.sdpa import dense_max_logits, sdpa_attn
             from ..kernels.sdpa_online import sdpa_online_attn
 
             dense_fn = sdpa_attn if self.backend == "sdpa" else sdpa_online_attn
@@ -351,11 +365,22 @@ class DistAttnRuntime:
                 k_all = jnp.concatenate(parts_k, axis=0)
                 v_all = jnp.concatenate(parts_v, axis=0)
                 qr, kr, lo, hi = (a[0] for a in slices)
-                return dense_fn(
+                out, lse = dense_fn(
                     q, k_all, v_all, qr, kr, None,
                     softmax_scale=scale, softcap=softcap,
                     d_lo=lo, d_hi=hi,
                 )
+                # lse is non-differentiable on the ffa backend (custom VJP
+                # drops its cotangent); keep backends in agreement
+                lse = jax.lax.stop_gradient(lse)
+                if return_max_logits:
+                    ml = dense_max_logits(
+                        q, k_all, qr, kr, None,
+                        softmax_scale=scale, softcap=softcap,
+                        d_lo=lo, d_hi=hi,
+                    )
+                    return out, lse, jax.lax.pmax(ml, axis)
+                return out, lse
 
             fn = shard_map(
                 f,
@@ -364,7 +389,7 @@ class DistAttnRuntime:
                           [tuple(P(axis) for _ in ops)
                            for ops in self._cast_ops],
                           tuple(P(axis) for _ in self._merged_slices)),
-                out_specs=(spec, spec),
+                out_specs=out_specs,
                 check_vma=False,
             )
             return fn(q, k, v, self._cast_ops, self._merged_slices)
@@ -381,8 +406,13 @@ class DistAttnRuntime:
                 k_all = jnp.concatenate(kv_parts_k, axis=0)
                 v_all = jnp.concatenate(kv_parts_v, axis=0)
                 local_arrays = tuple(a[0] for a in arrays)
-                out, lse = ffa_attn_with_plan(q, k_all, v_all, local_arrays, params)
-                return out, lse
+                if return_max_logits:
+                    out, lse, ml = ffa_attn_with_plan(
+                        q, k_all, v_all, local_arrays, params,
+                        return_max_logits=True,
+                    )
+                    return out, lse, jax.lax.pmax(ml, axis)
+                return ffa_attn_with_plan(q, k_all, v_all, local_arrays, params)
 
             fn = shard_map(
                 f,
@@ -391,7 +421,7 @@ class DistAttnRuntime:
                           [tuple(P(axis) for _ in ops)
                            for ops in self._cast_ops],
                           tuple(P(axis) for _ in self._merged_arrays)),
-                out_specs=(spec, spec),
+                out_specs=out_specs,
                 check_vma=False,
             )
             return fn(q, k, v, self._cast_ops, self._merged_arrays)
@@ -415,7 +445,12 @@ class DistAttnRuntime:
             arrays_list = (tuple(a[0] for a in host_arrays),) + tuple(
                 tuple(a[0] for a in sa) for sa in stage_arrays
             )
-            return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, all_params)
+            out, lse, ml = _multi_ffa(
+                q, tuple(ks), tuple(vs), arrays_list, all_params
+            )
+            if return_max_logits:
+                return out, lse, jax.lax.pmax(ml, axis)
+            return out, lse
 
         fn = shard_map(
             f,
@@ -425,7 +460,7 @@ class DistAttnRuntime:
                        for ops in self._cast_ops],
                       tuple(P(axis) for _ in self._host_arrays),
                       [tuple(P(axis) for _ in sa) for sa in self._stage_arrays]),
-            out_specs=(spec, spec),
+            out_specs=out_specs,
             check_vma=False,
         )
         return fn(q, k, v, self._cast_ops,
@@ -437,12 +472,13 @@ def dist_attn_func(
     k: jax.Array,
     v: jax.Array,
     runtime: DistAttnRuntime,
-) -> tuple[jax.Array, jax.Array]:
-    """Functional entry (ref dist_attn.py:3714): (out, lse) over dispatched
-    tensors. Precision override via MAGI_ATTENTION_PRECISION."""
+    return_max_logits: bool = False,
+):
+    """Functional entry (ref dist_attn.py:3714): (out, lse[, max_logits])
+    over dispatched tensors. Precision override via MAGI_ATTENTION_PRECISION."""
     if env_general.precision() == "bf16":
         q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
-    return runtime.calc_attn(q, k, v)
+    return runtime.calc_attn(q, k, v, return_max_logits=return_max_logits)
 
 
 def _ceil_to(x: int, m: int) -> int:

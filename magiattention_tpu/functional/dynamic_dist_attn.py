@@ -68,8 +68,8 @@ def _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx):
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _dyn_attn_shard(q, k, v, static, axis, comm, arrays):
-    out, lse, _, _, _ = _dyn_fwd_impl(q, k, v, static, axis, comm, arrays)
-    return out, lse
+    out, lse, ml, _, _, _ = _dyn_fwd_impl(q, k, v, static, axis, comm, arrays)
+    return out, lse, ml
 
 
 def _dyn_fwd_impl(q, k, v, static, axis, comm, arrays):
@@ -81,20 +81,22 @@ def _dyn_fwd_impl(q, k, v, static, axis, comm, arrays):
     v_rem = group_cast_rows(v, k_send, k_recv, axis)
     k_buf = jnp.concatenate([k, k_rem], axis=0)
     v_buf = jnp.concatenate([v, v_rem], axis=0)
-    out_buf, lse_buf = ffa_attn_with_plan(q_buf, k_buf, v_buf, arrays, params)
+    out_buf, lse_buf, ml = ffa_attn_with_plan(
+        q_buf, k_buf, v_buf, arrays, params, return_max_logits=True
+    )
     ret_out = group_cast_rows(out_buf, r_send, r_recv, axis)
     ret_lse = group_cast_rows(lse_buf, r_send, r_recv, axis)
     out, lse = _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx)
-    return out, lse, q_buf, k_buf, v_buf
+    return out, lse, ml, q_buf, k_buf, v_buf
 
 
 def _dyn_fwd(q, k, v, static, axis, comm, arrays):
-    out, lse, _, _, _ = _dyn_fwd_impl(q, k, v, static, axis, comm, arrays)
-    return (out, lse), (q, k, v, out, lse, comm, arrays)
+    out, lse, ml, _, _, _ = _dyn_fwd_impl(q, k, v, static, axis, comm, arrays)
+    return (out, lse, ml), (q, k, v, out, lse, comm, arrays)
 
 
 def _dyn_bwd(static, axis, res, cts):
-    do, _ = cts  # lse is auxiliary
+    do, _, _ = cts  # lse/max_logits are auxiliary
     q, k, v, out, lse, comm, arrays = res
     params, shard, kv_shard = static
     (q_send, q_recv, k_send, k_recv, _, _, _) = comm
@@ -204,9 +206,16 @@ class DynamicDistAttnRuntime:
         return env_general.kernel_backend()
 
     def calc_attn(
-        self, q: jax.Array, k: jax.Array, v: jax.Array
-    ) -> tuple[jax.Array, jax.Array]:
-        """(out, lse) over dispatched tensors, qo-comm execution.
+        self,
+        q: jax.Array,
+        k: jax.Array,
+        v: jax.Array,
+        return_max_logits: bool = False,
+    ):
+        """(out, lse[, max_logits]) over dispatched tensors, qo-comm
+        execution. lse is a non-differentiable auxiliary output on every
+        backend (the ffa custom VJP ignores its cotangent, so the jnp
+        backends stop_gradient it for cross-backend agreement).
 
         q/k/v: ``(cp*shard, h, d)`` dispatched layout sharded over cp axis.
         """
@@ -223,7 +232,7 @@ class DynamicDistAttnRuntime:
         spec = P(axis)
 
         if self.backend in ("sdpa", "sdpa_online"):
-            return self._calc_attn_sdpa(q, k, v, scale)
+            return self._calc_attn_sdpa(q, k, v, scale, return_max_logits)
 
         nqt, nkt, w, wt = self._dims
         params = FFAParams(
@@ -237,25 +246,32 @@ class DynamicDistAttnRuntime:
         def f(q, k, v, comm, arrays):
             comm_local = tuple(c[0] for c in comm)
             arrays_local = tuple(a[0] for a in arrays)
-            return _dyn_attn_shard(
+            # each rank's compute covers its assigned rectangles, so the
+            # cp MAX of the kernel's per-head max is the global per-head
+            # max (ref dist_attn.py:550 reduce_max_logits)
+            out, lse, ml = _dyn_attn_shard(
                 q, k, v, static, axis, comm_local, arrays_local
             )
+            if return_max_logits:
+                return out, lse, jax.lax.pmax(ml, axis)
+            return out, lse
 
+        out_specs = (spec, spec, P()) if return_max_logits else (spec, spec)
         fn = shard_map(
             f,
             mesh=self.mesh,
             in_specs=(spec, spec, spec,
                       tuple(P(axis) for _ in self._comm),
                       tuple(P(axis) for _ in self._arrays)),
-            out_specs=(spec, spec),
+            out_specs=out_specs,
             check_vma=False,
         )
         return fn(q, k, v, self._comm, self._arrays)
 
     # -- jnp fake-backend path (fp32/fp64-exact distributed testing) -------
 
-    def _calc_attn_sdpa(self, q, k, v, scale):
-        from ..kernels.sdpa import sdpa_attn
+    def _calc_attn_sdpa(self, q, k, v, scale, return_max_logits=False):
+        from ..kernels.sdpa import dense_max_logits, sdpa_attn
         from ..kernels.sdpa_online import sdpa_online_attn
 
         p = self.plan
@@ -295,15 +311,28 @@ class DynamicDistAttnRuntime:
             )
             ret_out = group_cast_rows(out_buf, r_send, r_recv, axis)
             ret_lse = group_cast_rows(lse_buf, r_send, r_recv, axis)
-            return _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx)
+            out, lse = _merge_rows(
+                out_buf, lse_buf, ret_out, ret_lse, merge_idx
+            )
+            # lse is non-differentiable on the ffa backend (custom VJP drops
+            # its cotangent); stop_gradient keeps the backends in agreement
+            lse = jax.lax.stop_gradient(lse)
+            if return_max_logits:
+                ml = dense_max_logits(
+                    q_buf, k_buf, qr, kr, None,
+                    softmax_scale=scale, softcap=softcap, d_lo=lo, d_hi=hi,
+                )
+                return out, lse, jax.lax.pmax(ml, axis)
+            return out, lse
 
+        out_specs = (spec, spec, P()) if return_max_logits else (spec, spec)
         fn = shard_map(
             f,
             mesh=self.mesh,
             in_specs=(spec, spec, spec,
                       tuple(P(axis) for _ in self._comm),
                       tuple(P(axis) for _ in slices)),
-            out_specs=(spec, spec),
+            out_specs=out_specs,
             check_vma=False,
         )
         return fn(q, k, v, self._comm, slices)

@@ -112,11 +112,15 @@ def flex_flash_attn_func(
         else:
             from ..kernels.ffa import ffa_attn
 
-            out, lse = ffa_attn(
+            res = ffa_attn(
                 q, k, v, qr, kr, tmap,
                 softmax_scale=softmax_scale, softcap=softcap,
-                d_lo=d_lo, d_hi=d_hi,
+                d_lo=d_lo, d_hi=d_hi, return_max_logits=return_max_logits,
             )
+            if return_max_logits:
+                out, lse, max_logits = res
+            else:
+                out, lse = res
     else:
         raise ValueError(f"unknown kernel backend: {backend}")
 
@@ -129,9 +133,20 @@ def flex_flash_attn_func(
 
     meta = AttnForwardMeta(lse=lse)
     if return_max_logits:
-        # max logit per head; derive from lse lower bound is wrong — compute
-        # via the sdpa path only when explicitly requested (testing aid).
-        meta.max_logits = jnp.max(lse, axis=0)
+        # per-head max of the (scaled, softcapped) REAL attention logits —
+        # the fwd kernel's tracked softmax max (ref forward_meta.py:21); the
+        # sink's virtual logit is not included. The jnp backends use the
+        # dense oracle.
+        if backend == "ffa" and sink is None:
+            meta.max_logits = max_logits
+        else:
+            from ..kernels.sdpa import dense_max_logits
+
+            meta.max_logits = dense_max_logits(
+                q, k, qr, kr, tmap,
+                softmax_scale=softmax_scale, softcap=softcap,
+                d_lo=d_lo, d_hi=d_hi,
+            )
     return out, meta
 
 
@@ -191,7 +206,7 @@ def _ffa_sink_fwd_impl(q, k, v, sink, arrays, params):
 
     sqp = params.num_q_tiles * params.block_q
     skp = params.num_k_tiles * params.block_k
-    out_t, lse_t = _ffa_fwd_pallas(
+    out_t, lse_t, _ = _ffa_fwd_pallas(
         params, *arrays[:3],
         _head_major(q, sqp), _head_major(k, skp), _head_major(v, skp),
     )

@@ -19,8 +19,26 @@ schedulers, mask.h). Design differences, deliberate and TPU-first:
 - Online-softmax merge math matches functional/utils.py (lse in natural log,
   -inf on fully-masked rows).
 
-Layouts inside the kernels are head-major ``[h, s, d]`` so each block is a
-contiguous ``(s_tile, d)`` matrix on the MXU.
+Mosaic-compatibility notes (mirrors the bundled TPU kernels
+jax/experimental/pallas/ops/tpu/{flash_attention,splash_attention}):
+
+- No ``-inf`` arithmetic inside kernels: masking uses a large finite
+  ``MASK_VALUE`` (splash's DEFAULT_MASK_VALUE); fully-masked rows are detected
+  by threshold at finalize and converted to (out=0, lse=-inf) on the host.
+- No ``lax.cond`` over tiles: the full-tile fast path ORs the band mask with a
+  scalar ``is_full`` flag (splash's ``should_not_mask`` idiom).
+- lse is emitted broadcast across ``NUM_LANES`` (out block ``(bq, 128)``,
+  like splash's logsumexp) and sliced on the host; the backward kernels read
+  lse/delta from a lanes-major layout ``(hq, sublanes, sqp)`` with q in the
+  lane dimension (splash's backward logsumexp layout).
+- m/l scratch are ``(bq, NUM_LANES)`` fp32; softmax rescale uses
+  ``jnp.tile`` over 128-lane groups (both bundled kernels' idiom) which
+  requires ``block_k % 128 == 0`` — guaranteed by :func:`default_blocks`.
+
+max_logits: the fwd kernel additionally emits the per-(head, q-tile) running
+max of the (scaled, softcapped) logits — the TPU equivalent of the CUDA
+softmax max tracking (ref csrc/flexible_flash_attention/softmax.h, surfaced
+via common/forward_meta.py:21) — reduced to per-head [hq] on the host.
 """
 
 from __future__ import annotations
@@ -52,6 +70,13 @@ from .ffa_plan import (  # noqa: F401
 from .mask_utils import types_to_bands
 
 NEG_INF = float("-inf")
+NUM_LANES = 128
+NUM_SUBLANES = 8
+# splash's DEFAULT_MASK_VALUE: large but finite so no inf arithmetic reaches
+# Mosaic; exp(MASK_VALUE - anything_sane) underflows to exactly 0.
+MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+# anything at or below this is "never attended" (real logits are O(1e2))
+EMPTY_THRESH = 0.5 * MASK_VALUE
 
 
 def _round_up(x: int, m: int) -> int:
@@ -93,10 +118,13 @@ def _item_mask(
 
     Shape (bq, bk) with q rows, or (bk, bq) when ``transposed`` (k rows) —
     built directly with swapped iota since Mosaic cannot transpose i1 vectors.
+    The scalar is_full flag is OR-ed in (splash's should_not_mask idiom), so
+    interior tiles need no separate code path.
     """
     qs, qe = meta_ref[w, QS], meta_ref[w, QE]
     ks, ke = meta_ref[w, KS], meta_ref[w, KE]
     lo, hi = meta_ref[w, DLO], meta_ref[w, DHI]
+    full = meta_ref[w, IS_FULL] == 1
     if transposed:
         rows = q_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
         cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
@@ -105,7 +133,17 @@ def _item_mask(
         cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     in_rect = (rows >= qs) & (rows < qe) & (cols >= ks) & (cols < ke)
     d = cols - rows
-    return in_rect & (d >= lo) & (d <= hi)
+    band = in_rect & (d >= lo) & (d <= hi)
+    return band | jnp.broadcast_to(full, band.shape)
+
+
+def _lane_tile(col, width: int):
+    """(r, NUM_LANES) fp32 -> (r, width) by lane-group tiling (flash_attention
+    idiom; width % NUM_LANES == 0) or slicing (width < NUM_LANES)."""
+    if width <= NUM_LANES:
+        return col[:, :width]
+    assert width % NUM_LANES == 0, f"{width=} not a multiple of {NUM_LANES}"
+    return jnp.tile(col, (1, width // NUM_LANES))
 
 
 # ---------------------------------------------------------------------------
@@ -122,6 +160,7 @@ def _fwd_kernel(
     v_ref,
     out_ref,
     lse_ref,
+    ml_ref,
     m_scr,
     l_scr,
     acc_scr,
@@ -139,7 +178,7 @@ def _fwd_kernel(
 
     @pl.when(is_first == 1)
     def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        m_scr[:] = jnp.full_like(m_scr, MASK_VALUE)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
@@ -150,55 +189,59 @@ def _fwd_kernel(
     ) * scale
     if softcap > 0.0:
         s = softcap * jnp.tanh(s / softcap)
-    # interior (fully-unmasked) tiles skip the mask build + select entirely
-    # — the TPU analogue of the reference schedulers' full-tile fast path
-    s = jax.lax.cond(
-        meta_ref[w, IS_FULL] == 1,
-        lambda s: s,
-        lambda s: jnp.where(
-            _item_mask(meta_ref, w, q_base, k_base, bq, bk), s, NEG_INF
-        ),
-        s,
+    s = jnp.where(
+        _item_mask(meta_ref, w, q_base, k_base, bq, bk), s, MASK_VALUE
     )
 
-    m_prev = m_scr[:, :1]  # (bq, 1)
-    m_blk = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_blk)
-    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-    p = jnp.exp(s - m_safe)  # exp(-inf - finite) == 0: no re-masking needed
-    alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev = -inf, m_safe finite
-    alpha = jnp.where(jnp.isneginf(m_prev) & jnp.isneginf(m_new), 0.0, alpha)
+    m_prev = m_scr[...]  # (bq, NUM_LANES)
+    m_blk = jnp.max(s, axis=1)[:, None]  # (bq, 1)
+    m_new = jnp.maximum(m_prev, m_blk)  # (bq, NUM_LANES)
+    p = jnp.exp(s - _lane_tile(m_new, bk))
+    alpha = jnp.exp(m_prev - m_new)  # (bq, NUM_LANES); ==1 while still empty
 
-    l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    l_new = l_scr[...] * alpha + jnp.sum(p, axis=1)[:, None]
     pv = jax.lax.dot_general(
         p.astype(v_ref.dtype),
         v_ref[0],
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    acc_scr[:] = acc_scr[:] * alpha + pv
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    acc_scr[:] = acc_scr[:] * _lane_tile(alpha, acc_scr.shape[-1]) + pv
+    m_scr[:] = m_new
+    l_scr[:] = l_new
 
     @pl.when(is_last == 1)
     def _():
-        l = l_scr[:, :1]
-        empty = l == 0.0
-        l_safe = jnp.where(empty, 1.0, l)
-        out_ref[0] = (acc_scr[:] / l_safe).astype(out_ref.dtype)
-        lse = jnp.where(
-            empty[:, 0], NEG_INF, m_scr[:, 0] + jnp.log(l_safe[:, 0])
+        m = m_scr[...]
+        l = l_scr[...]
+        # rows never covered by any slice: m stayed at MASK_VALUE (l holds
+        # exp(0)-garbage from masked-only tiles) -> out 0, lse MASK-flagged
+        # (converted to -inf on the host)
+        empty = m <= EMPTY_THRESH
+        l_safe = jnp.where(empty | (l == 0.0), 1.0, l)
+        o = acc_scr[:] / _lane_tile(l_safe, acc_scr.shape[-1])
+        o = jnp.where(_lane_tile(empty, o.shape[-1]), 0.0, o)
+        out_ref[0] = o.astype(out_ref.dtype)
+        lse_ref[...] = jnp.where(
+            empty, MASK_VALUE, m + jnp.log(l_safe)
+        ).astype(jnp.float32)
+        ml_ref[...] = jnp.broadcast_to(jnp.max(m), ml_ref.shape).astype(
+            jnp.float32
         )
-        lse_ref[...] = lse.astype(jnp.float32)[:, None]
 
 
 def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
-    """q_t/k_t/v_t are head-major padded: [hq,sqp,d], [hk,skp,d], [hk,skp,dv]."""
+    """q_t/k_t/v_t are head-major padded: [hq,sqp,d], [hk,skp,d], [hk,skp,dv].
+
+    Returns (out_t [hq,sqp,dv], lse_t [hq,sqp] fp32 with -inf on uncovered
+    rows, ml [hq] fp32 per-head max logit with -inf for never-covered heads).
+    """
     bq, bk = params.block_q, params.block_k
     hq, sqp, d = q_t.shape
     hk, skp, dv = v_t.shape
     g = params.group
     W = params.num_work
+    nqt = params.num_q_tiles
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -223,13 +266,17 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                (None, bq, NUM_LANES), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, 1, NUM_LANES), lambda h, w, qt, kt, mt: (h, qt[w], 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, NUM_LANES), jnp.float32),
+            pltpu.VMEM((bq, NUM_LANES), jnp.float32),
             pltpu.VMEM((bq, dv), jnp.float32),
         ],
     )
@@ -241,12 +288,13 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
         bq=bq,
         bk=bk,
     )
-    out_t, lse_t = pl.pallas_call(
+    out_t, lse_b, ml_b = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((hq, sqp, dv), q_t.dtype),
-            jax.ShapeDtypeStruct((hq, sqp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hq, sqp, NUM_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((hq, nqt, NUM_LANES), jnp.float32),
         ],
         interpret=params.interpret,
         cost_estimate=pl.CostEstimate(
@@ -255,12 +303,22 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
             transcendentals=W * bq * bk * hq,
         ),
     )(work_qt, work_kt, meta, q_t, k_t, v_t)
-    return out_t, lse_t[..., 0]
+    lse_raw = lse_b[..., 0]  # (hq, sqp)
+    lse_t = jnp.where(lse_raw <= EMPTY_THRESH, NEG_INF, lse_raw)
+    ml_raw = jnp.max(ml_b, axis=(1, 2))  # (hq,)
+    ml = jnp.where(ml_raw <= EMPTY_THRESH, NEG_INF, ml_raw)
+    return out_t, lse_t, ml
 
 
 # ---------------------------------------------------------------------------
 # backward: dq (q-major plan)
 # ---------------------------------------------------------------------------
+
+
+def _lanes_layout(x: jax.Array, sublanes: int) -> jax.Array:
+    """(hq, sqp) fp32 -> (hq, sublanes, sqp): q in the lane dim, broadcast
+    over sublanes (splash's backward logsumexp/di layout)."""
+    return jnp.broadcast_to(x[:, None, :], (x.shape[0], sublanes, x.shape[1]))
 
 
 def _bwd_dq_kernel(
@@ -302,26 +360,24 @@ def _bwd_dq_kernel(
     else:
         sc = s
         dcap = None
-    sm = jax.lax.cond(
-        meta_ref[w, IS_FULL] == 1,
-        lambda s: s,
-        lambda s: jnp.where(
-            _item_mask(meta_ref, w, q_base, k_base, bq, bk), s, NEG_INF
-        ),
-        sc,
+    sm = jnp.where(
+        _item_mask(meta_ref, w, q_base, k_base, bq, bk), sc, MASK_VALUE
     )
 
-    lse = lse_ref[:, 0]  # (bq,) f32
-    neg = jnp.isneginf(lse)
+    # lse/delta live q-in-lanes: ref block (1, bq); column views via
+    # expand_dims (splash dq idiom)
+    lse = jnp.expand_dims(lse_ref[0], -1)  # (bq, 1)
+    delta = jnp.expand_dims(delta_ref[0], -1)  # (bq, 1)
+    neg = lse <= EMPTY_THRESH  # uncovered rows (lse was -inf -> host clamps)
     lse_safe = jnp.where(neg, 0.0, lse)
-    p = jnp.exp(sm - lse_safe[:, None])
-    p = jnp.where(neg[:, None], 0.0, p)  # uncovered rows contribute nothing
+    p = jnp.exp(sm - lse_safe)  # exp(MASK_VALUE - O(1)) == 0: self-masking
+    p = jnp.where(neg, 0.0, p)
 
     dp = jax.lax.dot_general(
         do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta_ref[:, :1])
+    ds = p * (dp - delta)
     if dcap is not None:
         ds = ds * dcap
     ds = ds * scale
@@ -333,6 +389,12 @@ def _bwd_dq_kernel(
     @pl.when(is_last == 1)
     def _():
         dq_ref[0] = dq_scr[:]
+
+
+def _clamp_lse(lse_t: jax.Array) -> jax.Array:
+    """Replace -inf (uncovered-row lse) with MASK_VALUE so no inf enters the
+    kernels; threshold compares recover the flag."""
+    return jnp.maximum(lse_t, MASK_VALUE)
 
 
 def _ffa_bwd_dq_pallas(
@@ -356,9 +418,9 @@ def _ffa_bwd_dq_pallas(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, dv), lambda h, w, qt, kt, mt: (h, qt[w], 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+            pl.BlockSpec((None, 1, bq), lambda h, w, qt, kt, mt: (h, 0, qt[w]),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+            pl.BlockSpec((None, 1, bq), lambda h, w, qt, kt, mt: (h, 0, qt[w]),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -377,7 +439,7 @@ def _ffa_bwd_dq_pallas(
         out_shape=[jax.ShapeDtypeStruct((hq, sqp, d), jnp.float32)],
         interpret=params.interpret,
     )(work_qt, work_kt, meta, q_t, k_t, v_t, do_t,
-      lse_t[..., None], delta_t[..., None])
+      _lanes_layout(_clamp_lse(lse_t), 1), _lanes_layout(delta_t, 1))
     return dq_t
 
 
@@ -431,21 +493,18 @@ def _bwd_dkv_kernel(
     else:
         sc_t = s_t
         dcap_t = None
-    sm_t = jax.lax.cond(
-        meta_ref[w, IS_FULL] == 1,
-        lambda s: s,
-        lambda s: jnp.where(
-            _item_mask(meta_ref, w, q_base, k_base, bq, bk, transposed=True),
-            s, NEG_INF,
-        ),
-        sc_t,
+    sm_t = jnp.where(
+        _item_mask(meta_ref, w, q_base, k_base, bq, bk, transposed=True),
+        sc_t, MASK_VALUE,
     )
 
-    lse = lse_ref[:, 0]  # (bq,)
-    neg = jnp.isneginf(lse)
+    # lse/delta q-in-lanes rows: ref block (sublanes, bq) -> (1, bq) views
+    lse = lse_ref[:1, :]  # (1, bq)
+    delta = delta_ref[:1, :]  # (1, bq)
+    neg = lse <= EMPTY_THRESH
     lse_safe = jnp.where(neg, 0.0, lse)
-    p_t = jnp.exp(sm_t - lse_safe[None, :])
-    p_t = jnp.where(neg[None, :], 0.0, p_t)
+    p_t = jnp.exp(sm_t - lse_safe)
+    p_t = jnp.where(neg, 0.0, p_t)
 
     dv_scr[:] += jax.lax.dot_general(
         p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
@@ -454,7 +513,7 @@ def _bwd_dkv_kernel(
     dp_t = jax.lax.dot_general(
         v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    ds_t = p_t * (dp_t - delta_ref[:, 0][None, :])
+    ds_t = p_t * (dp_t - delta)
     if dcap_t is not None:
         ds_t = ds_t * dcap_t
     ds_t = ds_t * scale
@@ -491,10 +550,16 @@ def _ffa_bwd_dkv_pallas(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, dv), lambda h, w, qt, kt, mt: (h, qt[w], 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, bq, 1), lambda h, w, qt, kt, mt: (h, qt[w], 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (None, NUM_SUBLANES, bq),
+                lambda h, w, qt, kt, mt: (h, 0, qt[w]),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, NUM_SUBLANES, bq),
+                lambda h, w, qt, kt, mt: (h, 0, qt[w]),
+                memory_space=pltpu.VMEM,
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
@@ -520,7 +585,8 @@ def _ffa_bwd_dkv_pallas(
         ],
         interpret=params.interpret,
     )(work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
-      lse_t[..., None], delta_t[..., None])
+      _lanes_layout(_clamp_lse(lse_t), NUM_SUBLANES),
+      _lanes_layout(delta_t, NUM_SUBLANES))
     return dk_t, dv_t
 
 
@@ -541,16 +607,19 @@ def _ffa_core_fwd(
     q_t, k_t, v_t, work_qt, work_kt, meta, work_qt_t, work_kt_t, meta_t,
     params: FFAParams,
 ):
-    out_t, lse_t = _ffa_fwd_pallas(params, work_qt, work_kt, meta, q_t, k_t, v_t)
+    out_t, lse_t, ml = _ffa_fwd_pallas(
+        params, work_qt, work_kt, meta, q_t, k_t, v_t
+    )
     res = (q_t, k_t, v_t, out_t, lse_t, work_qt, work_kt, meta,
            work_qt_t, work_kt_t, meta_t)
-    return (out_t, lse_t), res
+    return (out_t, lse_t, ml), res
 
 
 def _ffa_core_bwd(params: FFAParams, res, cts):
-    # lse is an auxiliary output: its cotangent is ignored (the CP runtime
-    # differentiates the lse-merge manually, matching the reference).
-    do_t, _ = cts
+    # lse/max_logits are auxiliary outputs: their cotangents are ignored (the
+    # CP runtime differentiates the lse-merge manually, matching the
+    # reference).
+    do_t, _, _ = cts
     (q_t, k_t, v_t, out_t, lse_t, work_qt, work_kt, meta,
      work_qt_t, work_kt_t, meta_t) = res
     delta_t = jnp.sum(
@@ -592,7 +661,8 @@ def ffa_attn_with_plan(
     v: jax.Array,
     arrays: tuple[jax.Array, ...],
     params: FFAParams,
-) -> tuple[jax.Array, jax.Array]:
+    return_max_logits: bool = False,
+):
     """FFA over an explicit plan — the CP-runtime entry point.
 
     Args:
@@ -603,7 +673,8 @@ def ffa_attn_with_plan(
         params: static dims + scalars; sq/sk must fit the tile counts.
 
     Returns:
-        (out ``[sq,hq,dv]``, lse ``[sq,hq]`` fp32).
+        (out ``[sq,hq,dv]``, lse ``[sq,hq]`` fp32), plus per-head max_logits
+        ``[hq]`` fp32 when ``return_max_logits``.
     """
     sq, hq, d = q.shape
     sk, hk, dv = v.shape
@@ -612,8 +683,12 @@ def ffa_attn_with_plan(
     q_t = jnp.pad(q, ((0, sqp - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
     k_t = jnp.pad(k, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
     v_t = jnp.pad(v, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
-    out_t, lse_t = _ffa_core(q_t, k_t, v_t, *arrays, params)
-    return out_t.transpose(1, 0, 2)[:sq], lse_t.T[:sq]
+    out_t, lse_t, ml = _ffa_core(q_t, k_t, v_t, *arrays, params)
+    out = out_t.transpose(1, 0, 2)[:sq]
+    lse = lse_t.T[:sq]
+    if return_max_logits:
+        return out, lse, ml
+    return out, lse
 
 
 def default_blocks(sq: int, sk: int, block_q=None, block_k=None) -> tuple[int, int]:
@@ -635,7 +710,8 @@ def ffa_attn(
     block_k: int | None = None,
     d_lo=None,
     d_hi=None,
-) -> tuple[jax.Array, jax.Array]:
+    return_max_logits: bool = False,
+):
     """Pallas FFA over slice metadata. Same contract as sdpa_attn.
 
     Slices may be given as mask types (``attn_type_map``) or directly as
@@ -682,4 +758,6 @@ def ffa_attn(
         group=hq // hk,
         interpret=_should_interpret(),
     )
-    return ffa_attn_with_plan(q, k, v, plan_arrays(plan), params)
+    return ffa_attn_with_plan(
+        q, k, v, plan_arrays(plan), params, return_max_logits=return_max_logits
+    )

@@ -70,3 +70,37 @@ def sdpa_attn(
     out = jnp.einsum("hqk,khd->qhd", p, vc)
 
     return out.astype(q.dtype), lse.T.astype(jnp.float32)
+
+
+def dense_max_logits(
+    q: jax.Array,
+    k: jax.Array,
+    q_ranges: jax.Array,
+    k_ranges: jax.Array,
+    attn_type_map: jax.Array | None = None,
+    softmax_scale: float | None = None,
+    softcap: float = 0.0,
+    d_lo: jax.Array | None = None,
+    d_hi: jax.Array | None = None,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Per-head max of the (scaled, softcapped) masked logits: ``[hq]`` fp32,
+    -inf for heads with no attended entries. The dense oracle for the FFA
+    kernel's max_logits output (ref common/forward_meta.py:21)."""
+    sq, hq, d = q.shape
+    sk, hk, _ = k.shape
+    g = hq // hk
+    if softmax_scale is None:
+        softmax_scale = d ** -0.5
+    if d_lo is None or d_hi is None:
+        if attn_type_map is None:
+            attn_type_map = jnp.zeros((q_ranges.shape[0],), dtype=jnp.int32)
+        d_lo, d_hi = types_to_bands(q_ranges, k_ranges, attn_type_map)
+    mask = build_dense_mask_band(q_ranges, k_ranges, d_lo, d_hi, sq, sk)
+    qc = q.astype(compute_dtype)
+    kc = jnp.repeat(k.astype(compute_dtype), g, axis=1)
+    logits = jnp.einsum("qhd,khd->hqk", qc, kc) * softmax_scale
+    if softcap > 0.0:
+        logits = softcap * jnp.tanh(logits / softcap)
+    logits = jnp.where(mask[None, :, :], logits, NEG_INF)
+    return jnp.max(logits, axis=(1, 2)).astype(jnp.float32)

@@ -28,6 +28,11 @@ from .base import (
 
 
 class GRGAlg(DynamicAttnAlgorithm):
+    """SPMD caveat: ``seed`` (like every alg kwarg) MUST be identical on all
+    hosts — the plan is computed redundantly per host and a mismatched seed
+    desynchronizes the collective layout. Never derive it from a rank id;
+    it is part of the runtime cache key via DistAttnConfig."""
+
     def __init__(self, seed: int = 0, comm_weight: float = 1.0) -> None:
         self.seed = seed
         self.comm_weight = comm_weight

@@ -65,7 +65,7 @@ def allgather_attn(
         k_all = jax.lax.all_gather(k, cp_axis, axis=0, tiled=True)
         v_all = jax.lax.all_gather(v, cp_axis, axis=0, tiled=True)
         local = tuple(a[0] for a in arrays[0])
-        return _multi_ffa(q, (k_all,), (v_all,), (local,), (params,))
+        return _multi_ffa(q, (k_all,), (v_all,), (local,), (params,))[:2]
 
     spec = P(cp_axis)
     fn = shard_map(
@@ -137,7 +137,7 @@ def hybrid_cp_attn(
         arrays_list = tuple(
             tuple(a[0] for a in step_arrays[o]) for o in range(O)
         )
-        return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, params_list)
+        return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, params_list)[:2]
 
     spec = P((inter_axis, intra_axis))
     fn = shard_map(

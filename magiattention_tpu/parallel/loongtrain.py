@@ -103,7 +103,7 @@ def loongtrain_attn(
         arrays_list = tuple(
             tuple(a[0] for a in step_arrays[t]) for t in range(cp)
         )
-        return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, params_list)
+        return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, params_list)[:2]
 
     spec = P((outer_axis, inner_axis))
     fn = shard_map(

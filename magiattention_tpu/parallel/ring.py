@@ -66,7 +66,7 @@ def ring_attn(
         arrays_list = tuple(
             tuple(a[0] for a in step_arrays[s]) for s in range(cp)
         )
-        return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, params_list)
+        return _multi_ffa(q, tuple(ks), tuple(vs), arrays_list, params_list)[:2]
 
     fn = shard_map(
         f, mesh=mesh,

@@ -82,7 +82,7 @@ def usp_attn(
         arrays_list = tuple(
             tuple(a[0] for a in step_arrays[s]) for s in range(R)
         )
-        out_g, lse_g = _multi_ffa(
+        out_g, lse_g, _ = _multi_ffa(
             qg, tuple(ks), tuple(vs), arrays_list, params_list
         )
         out = a2a(out_g, 0, 1)

@@ -2,5 +2,5 @@
 
 from .precision import assert_close  # noqa: F401
 from .precompile import precompile_ffa  # noqa: F401
-from .ref_attn import ref_attn  # noqa: F401
+from .ref_attn import ref_attn, ref_max_logits  # noqa: F401
 from .template import assert_overlap_safe  # noqa: F401

@@ -75,3 +75,31 @@ def ref_attn(
     p = jnp.where(maskj_h, p, 0.0)
     out = jnp.einsum("hqk,khd->qhd", p, vc)
     return out.astype(q.dtype), lse.T.astype(jnp.float32)
+
+
+def ref_max_logits(
+    q,
+    k,
+    mask: np.ndarray,
+    softmax_scale: float | None = None,
+    softcap: float = 0.0,
+    compute_dtype=None,
+) -> jax.Array:
+    """Per-head max of the (scaled, softcapped) masked logits ``[hq]`` fp32 —
+    golden model for AttnForwardMeta.max_logits (ref forward_meta.py:21)."""
+    if compute_dtype is None:
+        compute_dtype = (
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        )
+    sq, hq, d = q.shape
+    sk, hk, _ = k.shape
+    g = hq // hk
+    if softmax_scale is None:
+        softmax_scale = d ** -0.5
+    qc = jnp.asarray(q, dtype=compute_dtype)
+    kc = jnp.repeat(jnp.asarray(k, dtype=compute_dtype), g, axis=1)
+    logits = jnp.einsum("qhd,khd->hqk", qc, kc) * softmax_scale
+    if softcap > 0.0:
+        logits = softcap * jnp.tanh(logits / softcap)
+    logits = jnp.where(jnp.asarray(np.asarray(mask))[None], logits, NEG_INF)
+    return jnp.max(logits, axis=(1, 2)).astype(jnp.float32)

@@ -182,3 +182,42 @@ def test_cross_attn_rectangular():
                      msg=f"{backend} cross out")
         assert_close(meta.lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=2e-5,
                      msg=f"{backend} cross lse")
+
+
+@pytest.mark.parametrize(
+    "case", ["causal", "varlen_full", "sliding_window", "empty_rows",
+             "shared_question"]
+)
+@pytest.mark.parametrize("backend", ["sdpa", "sdpa_online", "ffa"])
+def test_max_logits_matches_ref(case, backend):
+    from magiattention_tpu.testing import ref_max_logits
+
+    qr, kr, tm = MASK_CASES[case]
+    q, k, v = make_inputs(jnp.float32, seed=5)
+    _, meta = flex_flash_attn_func(
+        q, k, v, np.array(qr), np.array(kr), np.array(tm), backend=backend,
+        return_max_logits=True,
+    )
+    ml_ref = ref_max_logits(q, k, dense_mask(case))
+    assert meta.max_logits is not None
+    assert meta.max_logits.shape == (HQ,)
+    np.testing.assert_allclose(
+        np.asarray(meta.max_logits), np.asarray(ml_ref), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_max_logits_softcap():
+    from magiattention_tpu.testing import ref_max_logits
+
+    qr, kr, tm = MASK_CASES["causal"]
+    q, k, v = make_inputs(jnp.float32, seed=6)
+    for backend in ["sdpa", "ffa"]:
+        _, meta = flex_flash_attn_func(
+            q, k, v, np.array(qr), np.array(kr), np.array(tm),
+            backend=backend, softcap=5.0, return_max_logits=True,
+        )
+        ml_ref = ref_max_logits(q, k, dense_mask("causal"), softcap=5.0)
+        np.testing.assert_allclose(
+            np.asarray(meta.max_logits), np.asarray(ml_ref),
+            atol=1e-5, rtol=1e-5,
+        )

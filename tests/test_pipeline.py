@@ -224,3 +224,34 @@ def test_pipeline_cross_attention(mask_type):
                  msg=f"xattn {mask_type} out")
     assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
                  msg=f"xattn {mask_type} lse")
+
+
+@pytest.mark.parametrize("case", ["causal", "sliding_window", "varlen_causal"])
+def test_pipeline_max_logits(case):
+    """calc_attn(return_max_logits=True): per-head max logit, all-reduced
+    MAX across cp (ref dist_attn.py:550 reduce_max_logits)."""
+    from magiattention_tpu.testing import ref_max_logits
+
+    qr, kr, tm = CASES[case]
+    mesh = make_mesh(4)
+    key = magi_attn_flex_key(qr, kr, tm, S, S, mesh=mesh, chunk_size=CHUNK)
+    q, k, v = make_inputs(13)
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        [AttnMaskType.from_int_type(t) for t in tm],
+        total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+
+    def fwd(q, k, v):
+        q_d = dispatch(q, key)
+        k_d = dispatch(k, key, role="kv")
+        v_d = dispatch(v, key, role="kv")
+        _, meta = calc_attn(q_d, k_d, v_d, key, return_max_logits=True)
+        return meta.max_logits
+
+    ml = jax.jit(fwd)(q, k, v)
+    ml_ref = ref_max_logits(q, k, mask, compute_dtype=jnp.float32)
+    assert ml.shape == (H,)
+    np.testing.assert_allclose(
+        np.asarray(ml), np.asarray(ml_ref), atol=1e-5, rtol=1e-5
+    )
