@@ -225,9 +225,9 @@ def _fwd_kernel(
         lse_ref[...] = jnp.where(
             empty, MASK_VALUE, m + jnp.log(l_safe)
         ).astype(jnp.float32)
-        ml_ref[...] = jnp.broadcast_to(jnp.max(m), ml_ref.shape).astype(
-            jnp.float32
-        )
+        # per-row running max of scaled/softcapped logits (all lanes equal);
+        # host reduces rows -> per-head. Padded/empty rows stay MASK_VALUE.
+        ml_ref[...] = m.astype(jnp.float32)
 
 
 def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
@@ -270,7 +270,7 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (None, 1, NUM_LANES), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+                (None, bq, NUM_LANES), lambda h, w, qt, kt, mt: (h, qt[w], 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
@@ -294,7 +294,7 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
         out_shape=[
             jax.ShapeDtypeStruct((hq, sqp, dv), q_t.dtype),
             jax.ShapeDtypeStruct((hq, sqp, NUM_LANES), jnp.float32),
-            jax.ShapeDtypeStruct((hq, nqt, NUM_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((hq, sqp, NUM_LANES), jnp.float32),
         ],
         interpret=params.interpret,
         cost_estimate=pl.CostEstimate(

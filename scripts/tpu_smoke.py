@@ -1,0 +1,122 @@
+"""TPU compile-smoke: run the FFA Pallas kernels (fwd+bwd) under Mosaic on
+real silicon and check against the fp32 dense reference.
+
+Exits 0 on success; prints PASS/FAIL lines per case. Run standalone:
+    python scripts/tpu_smoke.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dense_mask(qr, kr, tm, sq, sk):
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.mask import AttnMask
+    from magiattention_tpu.common.ranges import AttnRanges
+
+    return AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr),
+        AttnRanges.from_ranges(kr),
+        [AttnMaskType.from_int_type(t) for t in tm],
+        total_seqlen_q=sq,
+        total_seqlen_k=sk,
+    ).mask_array
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    print("backend:", backend, jax.devices())
+    if backend != "tpu":
+        print("NOT A TPU — smoke is meaningless; exiting 1")
+        return 1
+
+    from magiattention_tpu.kernels.ffa import ffa_attn
+    from magiattention_tpu.testing.ref_attn import ref_attn
+
+    rc = 0
+    cases = [
+        # (name, sq, sk, hq, hk, d, qr, kr, tm, softcap)
+        ("causal-1k-d128", 1024, 1024, 4, 4, 128,
+         [[0, 1024]], [[0, 1024]], [1], 0.0),
+        ("full-2k-gqa-d128", 2048, 2048, 8, 2, 128,
+         [[0, 2048]], [[0, 2048]], [0], 0.0),
+        ("varlen-causal-d64", 1536, 1536, 4, 4, 64,
+         [[0, 700], [700, 1536]], [[0, 700], [700, 1536]], [1, 1], 0.0),
+        ("softcap-1k", 1024, 1024, 4, 4, 128,
+         [[0, 1024]], [[0, 1024]], [1], 30.0),
+    ]
+    for name, sq, sk, hq, hk, d, qr, kr, tm, cap in cases:
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv, kdo = jax.random.split(key, 4)
+        dtype = jnp.bfloat16
+        q = jax.random.normal(kq, (sq, hq, d), dtype)
+        k = jax.random.normal(kk, (sk, hk, d), dtype)
+        v = jax.random.normal(kv, (sk, hk, d), dtype)
+        do = jax.random.normal(kdo, (sq, hq, d), dtype)
+        scale = d ** -0.5
+
+        def loss(q, k, v):
+            out, lse, ml = ffa_attn(
+                q, k, v, qr, kr, tm, softmax_scale=scale, softcap=cap,
+                return_max_logits=True,
+            )
+            return (
+                jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32)),
+                (out, lse, ml),
+            )
+
+        try:
+            (_, (out, lse, ml)), grads = jax.value_and_grad(
+                loss, argnums=(0, 1, 2), has_aux=True
+            )(q, k, v)
+            out, lse, ml = jax.block_until_ready((out, lse, ml))
+            grads = jax.block_until_ready(grads)
+        except Exception as e:
+            print(f"FAIL {name}: kernel compile/run error: {type(e).__name__}: {e}")
+            rc = 1
+            continue
+
+        if cap == 0.0:
+            # fp32 dense reference + fp32 grads on the same chip
+            qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+            mask = dense_mask(qr, kr, tm, sq, sk)
+
+            def ref_loss(q, k, v):
+                ro, rlse = ref_attn(q, k, v, mask, softmax_scale=scale)
+                return jnp.sum(ro * do.astype(jnp.float32)), (ro, rlse)
+
+            (_, (ro, rlse)), rgrads = jax.value_and_grad(
+                ref_loss, argnums=(0, 1, 2), has_aux=True
+            )(qf, kf, vf)
+            err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ro)))
+            lse_err = float(jnp.max(jnp.abs(jnp.where(jnp.isinf(lse), 0.0, lse - rlse))))
+            gerrs = [
+                float(jnp.max(jnp.abs(g.astype(jnp.float32) - rg)))
+                / max(1.0, float(jnp.max(jnp.abs(rg))))
+                for g, rg in zip(grads, rgrads)
+            ]
+            ok = err < 8e-2 and lse_err < 1e-2 and max(gerrs) < 1e-1
+            print(
+                f"{'PASS' if ok else 'FAIL'} {name}: out_err={err:.4g} "
+                f"lse_err={lse_err:.4g} grad_rel_errs={[f'{e:.3g}' for e in gerrs]}"
+            )
+            if not ok:
+                rc = 1
+        else:
+            finite = bool(jnp.all(jnp.isfinite(out.astype(jnp.float32)))) and all(
+                bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))) for g in grads
+            )
+            print(f"{'PASS' if finite else 'FAIL'} {name}: softcap finite-check")
+            if not finite:
+                rc = 1
+    print("SMOKE", "PASS" if rc == 0 else "FAIL")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
