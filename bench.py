@@ -17,7 +17,7 @@ always recorded with its backend labeled.
 vs_baseline: achieved MFU divided by 0.5 — the reference's headline claim is
 "FFA has MFU comparable to FA3" (README.md:69) and FA3-class kernels sit
 around 50% MFU on their native hardware, so 1.0 means FA3-class efficiency
-on this chip. TPU v5e peak bf16 = 394 TFLOP/s.
+on this chip. TPU v5e peak bf16 = 197 TFLOP/s (394 is the int8 number).
 """
 
 import json
@@ -101,7 +101,7 @@ def run_worker() -> int:
     area = S * (S + 1) // 2
     flops = 4 * area * D * HQ * 3.5  # fwd + 2.5x bwd
     tflops = flops / (dt_ms * 1e-3) / 1e12
-    peak = 394.0  # v5e bf16 peak TFLOP/s
+    peak = 197.0  # v5e bf16 peak TFLOP/s
     mfu = tflops / peak
     vs_baseline = mfu / 0.5
 

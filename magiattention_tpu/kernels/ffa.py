@@ -97,6 +97,10 @@ class FFAParams:
     softcap: float
     group: int  # hq // hk
     interpret: bool
+    # emit the per-head max-logits output (ref forward_meta.py:21). Costs an
+    # extra (hq, sqp, 128) fp32 HBM write; turn off when the caller doesn't
+    # ask for it.
+    emit_max_logits: bool = True
 
 
 def plan_arrays(plan: FFAPlan) -> tuple[jax.Array, ...]:
@@ -158,23 +162,21 @@ def _fwd_kernel(
     q_ref,
     k_ref,
     v_ref,
-    out_ref,
-    lse_ref,
-    ml_ref,
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
-    scale: float,
+    *rest,
     softcap: float,
     bq: int,
     bk: int,
+    emit_ml: bool,
 ):
+    if emit_ml:
+        out_ref, lse_ref, ml_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        out_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        ml_ref = None
     w = pl.program_id(1)
     is_first = meta_ref[w, IS_FIRST]
     is_last = meta_ref[w, IS_LAST]
-    q_base = work_qt_ref[w] * bq
-    k_base = work_kt_ref[w] * bk
+    is_full = meta_ref[w, IS_FULL]
 
     @pl.when(is_first == 1)
     def _():
@@ -182,33 +184,49 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]
+    q = q_ref[0]  # pre-scaled by softmax_scale on the host
     k = k_ref[0]
-    s = jax.lax.dot_general(
+    s_raw = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    )
     if softcap > 0.0:
-        s = softcap * jnp.tanh(s / softcap)
-    s = jnp.where(
-        _item_mask(meta_ref, w, q_base, k_base, bq, bk), s, MASK_VALUE
-    )
+        s_raw = softcap * jnp.tanh(s_raw / softcap)
 
-    m_prev = m_scr[...]  # (bq, NUM_LANES)
-    m_blk = jnp.max(s, axis=1)[:, None]  # (bq, 1)
-    m_new = jnp.maximum(m_prev, m_blk)  # (bq, NUM_LANES)
-    p = jnp.exp(s - _lane_tile(m_new, bk))
-    alpha = jnp.exp(m_prev - m_new)  # (bq, NUM_LANES); ==1 while still empty
+    def update(s):
+        m_prev = m_scr[...]  # (bq, NUM_LANES)
+        m_blk = jnp.max(s, axis=1)[:, None]  # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_blk)  # (bq, NUM_LANES)
+        p = jnp.exp(s - _lane_tile(m_new, bk))
+        alpha = jnp.exp(m_prev - m_new)  # (bq, NUM_LANES); ==1 while empty
 
-    l_new = l_scr[...] * alpha + jnp.sum(p, axis=1)[:, None]
-    pv = jax.lax.dot_general(
-        p.astype(v_ref.dtype),
-        v_ref[0],
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_scr[:] = acc_scr[:] * _lane_tile(alpha, acc_scr.shape[-1]) + pv
-    m_scr[:] = m_new
-    l_scr[:] = l_new
+        l_new = l_scr[...] * alpha + jnp.sum(p, axis=1)[:, None]
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * _lane_tile(alpha, acc_scr.shape[-1]) + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    # interior tiles skip the band-mask arithmetic entirely (VPU is the
+    # bottleneck with bf16 MXUs; splash's should-not-mask split)
+    @pl.when(is_full == 1)
+    def _():
+        update(s_raw)
+
+    @pl.when(is_full == 0)
+    def _():
+        q_base = work_qt_ref[w] * bq
+        k_base = work_kt_ref[w] * bk
+        update(
+            jnp.where(
+                _item_mask(meta_ref, w, q_base, k_base, bq, bk),
+                s_raw,
+                MASK_VALUE,
+            )
+        )
 
     @pl.when(is_last == 1)
     def _():
@@ -225,9 +243,10 @@ def _fwd_kernel(
         lse_ref[...] = jnp.where(
             empty, MASK_VALUE, m + jnp.log(l_safe)
         ).astype(jnp.float32)
-        # per-row running max of scaled/softcapped logits (all lanes equal);
-        # host reduces rows -> per-head. Padded/empty rows stay MASK_VALUE.
-        ml_ref[...] = m.astype(jnp.float32)
+        if ml_ref is not None:
+            # per-row running max of scaled/softcapped logits (lanes equal);
+            # host reduces rows -> per-head. Empty rows stay MASK_VALUE.
+            ml_ref[...] = m.astype(jnp.float32)
 
 
 def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
@@ -241,8 +260,15 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
     hk, skp, dv = v_t.shape
     g = params.group
     W = params.num_work
-    nqt = params.num_q_tiles
+    emit_ml = params.emit_max_logits
 
+    # fold softmax_scale into q (saves a (bq,bk) VPU multiply per grid step)
+    q_t = (q_t.astype(jnp.float32) * params.softmax_scale).astype(q_t.dtype)
+
+    lse_spec = pl.BlockSpec(
+        (None, bq, NUM_LANES), lambda h, w, qt, kt, mt: (h, qt[w], 0),
+        memory_space=pltpu.VMEM,
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(hq, W),
@@ -265,15 +291,8 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
                 (1, bq, dv), lambda h, w, qt, kt, mt: (h, qt[w], 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(
-                (None, bq, NUM_LANES), lambda h, w, qt, kt, mt: (h, qt[w], 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (None, bq, NUM_LANES), lambda h, w, qt, kt, mt: (h, qt[w], 0),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
+            lse_spec,
+        ] + ([lse_spec] if emit_ml else []),
         scratch_shapes=[
             pltpu.VMEM((bq, NUM_LANES), jnp.float32),
             pltpu.VMEM((bq, NUM_LANES), jnp.float32),
@@ -283,30 +302,37 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
 
     kernel = partial(
         _fwd_kernel,
-        scale=params.softmax_scale,
         softcap=params.softcap,
         bq=bq,
         bk=bk,
+        emit_ml=emit_ml,
     )
-    out_t, lse_b, ml_b = pl.pallas_call(
+    lse_shape = jax.ShapeDtypeStruct((hq, sqp, NUM_LANES), jnp.float32)
+    outs = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((hq, sqp, dv), q_t.dtype),
-            jax.ShapeDtypeStruct((hq, sqp, NUM_LANES), jnp.float32),
-            jax.ShapeDtypeStruct((hq, sqp, NUM_LANES), jnp.float32),
-        ],
+            lse_shape,
+        ] + ([lse_shape] if emit_ml else []),
         interpret=params.interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
         cost_estimate=pl.CostEstimate(
             flops=4 * W * bq * bk * d * hq,
             bytes_accessed=(q_t.size + k_t.size + v_t.size) * q_t.dtype.itemsize,
             transcendentals=W * bq * bk * hq,
         ),
     )(work_qt, work_kt, meta, q_t, k_t, v_t)
+    out_t, lse_b = outs[0], outs[1]
     lse_raw = lse_b[..., 0]  # (hq, sqp)
     lse_t = jnp.where(lse_raw <= EMPTY_THRESH, NEG_INF, lse_raw)
-    ml_raw = jnp.max(ml_b, axis=(1, 2))  # (hq,)
-    ml = jnp.where(ml_raw <= EMPTY_THRESH, NEG_INF, ml_raw)
+    if emit_ml:
+        ml_raw = jnp.max(outs[2], axis=(1, 2))  # (hq,)
+        ml = jnp.where(ml_raw <= EMPTY_THRESH, NEG_INF, ml_raw)
+    else:
+        ml = jnp.full((hq,), NEG_INF, dtype=jnp.float32)
     return out_t, lse_t, ml
 
 
@@ -334,7 +360,6 @@ def _bwd_dq_kernel(
     dq_ref,
     dq_scr,
     *,
-    scale: float,
     softcap: float,
     bq: int,
     bk: int,
@@ -342,49 +367,65 @@ def _bwd_dq_kernel(
     w = pl.program_id(1)
     is_first = meta_ref[w, IS_FIRST]
     is_last = meta_ref[w, IS_LAST]
-    q_base = work_qt_ref[w] * bq
-    k_base = work_kt_ref[w] * bk
+    is_full = meta_ref[w, IS_FULL]
 
     @pl.when(is_first == 1)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0]
+    q = q_ref[0]  # pre-scaled by softmax_scale on the host
     k = k_ref[0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    )
     if softcap > 0.0:
         sc = softcap * jnp.tanh(s / softcap)
         dcap = 1.0 - (sc / softcap) ** 2
     else:
         sc = s
         dcap = None
-    sm = jnp.where(
-        _item_mask(meta_ref, w, q_base, k_base, bq, bk), sc, MASK_VALUE
-    )
 
     # lse/delta live q-in-lanes: ref block (1, bq); column views via
     # expand_dims (splash dq idiom)
     lse = jnp.expand_dims(lse_ref[0], -1)  # (bq, 1)
     delta = jnp.expand_dims(delta_ref[0], -1)  # (bq, 1)
-    neg = lse <= EMPTY_THRESH  # uncovered rows (lse was -inf -> host clamps)
-    lse_safe = jnp.where(neg, 0.0, lse)
-    p = jnp.exp(sm - lse_safe)  # exp(MASK_VALUE - O(1)) == 0: self-masking
-    p = jnp.where(neg, 0.0, p)
-
     dp = jax.lax.dot_general(
         do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta)
-    if dcap is not None:
-        ds = ds * dcap
-    ds = ds * scale
-    dq_scr[:] += jax.lax.dot_general(
-        ds.astype(q_ref.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+
+    def accum(sm, masked: bool):
+        if masked:
+            neg = lse <= EMPTY_THRESH  # uncovered rows (host clamps -inf)
+            lse_safe = jnp.where(neg, 0.0, lse)
+            p = jnp.exp(sm - lse_safe)  # exp(MASK_VALUE - O(1)) == 0
+            p = jnp.where(neg, 0.0, p)
+        else:
+            # a full tile's rows are covered by definition -> lse finite
+            p = jnp.exp(sm - lse)
+        ds = p * (dp - delta)
+        if dcap is not None:
+            ds = ds * dcap
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(is_full == 1)
+    def _():
+        accum(sc, masked=False)
+
+    @pl.when(is_full == 0)
+    def _():
+        q_base = work_qt_ref[w] * bq
+        k_base = work_kt_ref[w] * bk
+        accum(
+            jnp.where(
+                _item_mask(meta_ref, w, q_base, k_base, bq, bk),
+                sc, MASK_VALUE,
+            ),
+            masked=True,
+        )
 
     @pl.when(is_last == 1)
     def _():
@@ -405,6 +446,9 @@ def _ffa_bwd_dq_pallas(
     _, _, dv = v_t.shape
     g = params.group
     W = params.num_work
+
+    # pre-scale q; the missing scale factor on ds is applied to dq on return
+    q_t = (q_t.astype(jnp.float32) * params.softmax_scale).astype(q_t.dtype)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -430,7 +474,7 @@ def _ffa_bwd_dq_pallas(
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
     )
     kernel = partial(
-        _bwd_dq_kernel, scale=params.softmax_scale, softcap=params.softcap,
+        _bwd_dq_kernel, softcap=params.softcap,
         bq=bq, bk=bk,
     )
     (dq_t,) = pl.pallas_call(
@@ -438,9 +482,12 @@ def _ffa_bwd_dq_pallas(
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((hq, sqp, d), jnp.float32)],
         interpret=params.interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
     )(work_qt, work_kt, meta, q_t, k_t, v_t, do_t,
       _lanes_layout(_clamp_lse(lse_t), 1), _lanes_layout(delta_t, 1))
-    return dq_t
+    return dq_t * params.softmax_scale
 
 
 # ---------------------------------------------------------------------------
@@ -463,7 +510,6 @@ def _bwd_dkv_kernel(
     dk_scr,
     dv_scr,
     *,
-    scale: float,
     softcap: float,
     bq: int,
     bk: int,
@@ -471,56 +517,72 @@ def _bwd_dkv_kernel(
     w = pl.program_id(1)
     is_first = meta_ref[w, IS_FIRST]
     is_last = meta_ref[w, IS_LAST]
-    q_base = work_qt_ref[w] * bq
-    k_base = work_kt_ref[w] * bk
+    is_full = meta_ref[w, IS_FULL]
 
     @pl.when(is_first == 1)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0]
+    q = q_ref[0]  # pre-scaled by softmax_scale on the host: dk = ds_t @ q'
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
     # s_t: (bk, bq) — k rows, q cols
     s_t = jax.lax.dot_general(
         k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    )
     if softcap > 0.0:
         sc_t = softcap * jnp.tanh(s_t / softcap)
         dcap_t = 1.0 - (sc_t / softcap) ** 2
     else:
         sc_t = s_t
         dcap_t = None
-    sm_t = jnp.where(
-        _item_mask(meta_ref, w, q_base, k_base, bq, bk, transposed=True),
-        sc_t, MASK_VALUE,
-    )
 
     # lse/delta q-in-lanes rows: ref block (sublanes, bq) -> (1, bq) views
     lse = lse_ref[:1, :]  # (1, bq)
     delta = delta_ref[:1, :]  # (1, bq)
-    neg = lse <= EMPTY_THRESH
-    lse_safe = jnp.where(neg, 0.0, lse)
-    p_t = jnp.exp(sm_t - lse_safe)
-    p_t = jnp.where(neg, 0.0, p_t)
-
-    dv_scr[:] += jax.lax.dot_general(
-        p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
     dp_t = jax.lax.dot_general(
         v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    ds_t = p_t * (dp_t - delta)
-    if dcap_t is not None:
-        ds_t = ds_t * dcap_t
-    ds_t = ds_t * scale
-    dk_scr[:] += jax.lax.dot_general(
-        ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+
+    def accum(sm_t, masked: bool):
+        if masked:
+            neg = lse <= EMPTY_THRESH
+            lse_safe = jnp.where(neg, 0.0, lse)
+            p_t = jnp.exp(sm_t - lse_safe)
+            p_t = jnp.where(neg, 0.0, p_t)
+        else:
+            p_t = jnp.exp(sm_t - lse)
+        dv_scr[:] += jax.lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - delta)
+        if dcap_t is not None:
+            ds_t = ds_t * dcap_t
+        # q is pre-scaled, so ds_t @ q' == (ds_t * scale) @ q == dk exactly
+        dk_scr[:] += jax.lax.dot_general(
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(is_full == 1)
+    def _():
+        accum(sc_t, masked=False)
+
+    @pl.when(is_full == 0)
+    def _():
+        q_base = work_qt_ref[w] * bq
+        k_base = work_kt_ref[w] * bk
+        accum(
+            jnp.where(
+                _item_mask(meta_ref, w, q_base, k_base, bq, bk,
+                           transposed=True),
+                sc_t, MASK_VALUE,
+            ),
+            masked=True,
+        )
 
     @pl.when(is_last == 1)
     def _():
@@ -537,6 +599,9 @@ def _ffa_bwd_dkv_pallas(
     hk, skp, dv = v_t.shape
     g = params.group
     WT = params.num_work_t
+
+    # pre-scale q: dk = ds_t @ q' carries the scale factor exactly
+    q_t = (q_t.astype(jnp.float32) * params.softmax_scale).astype(q_t.dtype)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -573,7 +638,7 @@ def _ffa_bwd_dkv_pallas(
         ],
     )
     kernel = partial(
-        _bwd_dkv_kernel, scale=params.softmax_scale, softcap=params.softcap,
+        _bwd_dkv_kernel, softcap=params.softcap,
         bq=bq, bk=bk,
     )
     dk_t, dv_t = pl.pallas_call(
@@ -584,6 +649,9 @@ def _ffa_bwd_dkv_pallas(
             jax.ShapeDtypeStruct((hq, skp, dv), jnp.float32),
         ],
         interpret=params.interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
     )(work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
       _lanes_layout(_clamp_lse(lse_t), NUM_SUBLANES),
       _lanes_layout(delta_t, NUM_SUBLANES))
@@ -757,6 +825,7 @@ def ffa_attn(
         softcap=float(softcap),
         group=hq // hk,
         interpret=_should_interpret(),
+        emit_max_logits=return_max_logits,
     )
     return ffa_attn_with_plan(
         q, k, v, plan_arrays(plan), params, return_max_logits=return_max_logits
