@@ -77,6 +77,50 @@ def group_reduce_rows(
     )
 
 
+def group_cast_rows_pp(
+    x: jax.Array,
+    pp_send_idx: jax.Array,
+    pp_recv_sel: jax.Array,
+    deltas: tuple[int, ...],
+    caps: tuple[int, ...],
+    cp: int,
+    axis_name: str,
+) -> jax.Array:
+    """GroupCast lowered to one ppermute ring round per active distance.
+
+    Wire rows per rank = sum(caps) (each round padded only to its own
+    distance's max pair) instead of the all_to_all's cp * max-over-all-pairs
+    — near zero-redundant for skewed traffic (ref grpcoll/utils.py:593 true
+    per-pair splits). AD transposes each ppermute to its inverse ring, so
+    group_reduce stays free.
+
+    Args:
+        x: ``(shard, ...)`` local rows.
+        pp_send_idx: ``(sum_caps,)`` local rows to send, concatenated in
+            ``deltas`` order (rows for dst = (rank + delta) % cp).
+        pp_recv_sel: ``(R,)`` selectors into the concat-over-deltas receive
+            buffer (rows from src = (rank - delta) % cp).
+
+    Returns:
+        ``(R, ...)`` the remote rows this rank needs.
+    """
+    send = jnp.take(x, pp_send_idx, axis=0)  # (sum_caps, ...)
+    parts = []
+    off = 0
+    for delta, c in zip(deltas, caps):
+        perm = [(r, (r + delta) % cp) for r in range(cp)]
+        parts.append(
+            jax.lax.ppermute(
+                jax.lax.slice_in_dim(send, off, off + c, axis=0),
+                axis_name,
+                perm,
+            )
+        )
+        off += c
+    buf = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+    return jnp.take(buf, pp_recv_sel, axis=0)
+
+
 def all_gather_v(x: jax.Array, axis_name: str) -> jax.Array:
     """Gather all shards along axis 0 (equal shard sizes). Inside shard_map."""
     return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)

@@ -124,6 +124,7 @@ class DistAttnRuntimeMgr:
             self.bucket, self.dispatch_meta_q, key.config,
             dispatch_meta_kv=self.dispatch_meta_kv,
         )
+        self._log_comm_plan()
         overlap_cfg = key.config.overlap_config
         self.runtime = DistAttnRuntime(
             comm_meta=self.comm_meta,
@@ -135,6 +136,27 @@ class DistAttnRuntimeMgr:
             # forced single merged kernel when disabled
             use_overlap=None if overlap_cfg.enable else False,
         )
+
+    def _log_comm_plan(self) -> None:
+        """INFO-dump the comm plan at init (ref dist_attn_runtime_mgr.py:
+        673-1033 meta dumps + comm_meta.py:86-155 send/recv token counts):
+        per-stage payload rows, wire rows, padding ratio, chosen lowering."""
+        import logging
+
+        logger = logging.getLogger("magiattention_tpu.runtime")
+        if not logger.isEnabledFor(logging.INFO):
+            return
+        cm = self.comm_meta
+        for st, s in enumerate(cm.kv_stages):
+            logger.info(
+                "comm plan stage %d/%d: lowering=%s payload_rows=%d "
+                "wire_rows=%d ratio=%.3f (a2a would be %d) a_cap=%d r_max=%d "
+                "per-rank send rows=%s recv rows=%s",
+                st, len(cm.kv_stages), s.lowering, s.payload_rows(),
+                s.wire_rows(), s.wire_ratio(), s.wire_rows("a2a"), s.a_cap,
+                s.r_max, s.send_counts.sum(axis=1).tolist(),
+                s.recv_len.tolist(),
+            )
 
     # -- ops ---------------------------------------------------------------
 

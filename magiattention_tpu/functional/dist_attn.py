@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..comm.primitives import group_cast_rows
+from ..comm.primitives import group_cast_rows, group_cast_rows_pp
 from ..env import comm as env_comm
 from ..env import general as env_general
 from ..kernels.ffa import (
@@ -241,19 +241,26 @@ class DistAttnRuntime:
                         plan.b_send_idx, plan.b_recv_sel,
                     )
                 ))
-        self._send_idx = [
-            jnp.asarray(s.send_idx) for s in cm.kv_stages
-        ]  # each (cp, cp, A)
-        self._recv_sel = [
-            jnp.asarray(s.recv_sel) for s in cm.kv_stages
-        ]  # each (cp, R)
-        # unified per-stage cast operand tuples (flat: 2 arrays; hier: 4)
+        # unified per-stage cast operand tuples (flat/pp: 2 arrays; hier: 4)
+        # + per-stage static lowering descriptors (host-chosen, cheapest
+        # wire volume — see GroupCollectiveArg.lowering)
         if self._hier:
             self._cast_ops = self._hier_arrays
+            self._cast_kinds = [("hier",)] * len(self._hier_arrays)
         else:
-            self._cast_ops = [
-                (si, rs) for si, rs in zip(self._send_idx, self._recv_sel)
-            ]
+            self._cast_ops = []
+            self._cast_kinds = []
+            for s in cm.kv_stages:
+                if s.lowering == "ppermute":
+                    self._cast_ops.append(
+                        (jnp.asarray(s.pp_send_idx), jnp.asarray(s.pp_recv_sel))
+                    )
+                    self._cast_kinds.append(("pp", s.pp_deltas, s.pp_caps))
+                else:
+                    self._cast_ops.append(
+                        (jnp.asarray(s.send_idx), jnp.asarray(s.recv_sel))
+                    )
+                    self._cast_kinds.append(("a2a",))
 
         # merged slice arrays for the jnp (sdpa) backend path: (cp, N, 2)/(cp, N)
         n_max = max(a.num_slices for a in km.merged_args) or 1
@@ -263,8 +270,8 @@ class DistAttnRuntime:
             for f in ("q_ranges", "k_ranges", "d_lo", "d_hi")
         )
 
-    def _cast(self, x, ops):
-        """One stage's GroupCast inside shard_map (flat or hierarchical)."""
+    def _cast(self, x, ops, stage: int = 0):
+        """One stage's GroupCast inside shard_map (flat / pp / hierarchical)."""
         if self._hier:
             from ..comm.hier import hier_group_cast_rows
 
@@ -273,17 +280,23 @@ class DistAttnRuntime:
                 x, ops[0][0], ops[1][0], ops[2][0], ops[3][0],
                 dcn_axis, ici_axis,
             )
+        kind = self._cast_kinds[stage]
+        if kind[0] == "pp":
+            return group_cast_rows_pp(
+                x, ops[0][0], ops[1][0], kind[1], kind[2],
+                self.cp_size, self.cp_axis,
+            )
         return group_cast_rows(x, ops[0][0], ops[1][0], self.cp_axis)
 
-    def _cast_kv(self, k, v, ops):
+    def _cast_kv(self, k, v, ops, stage: int = 0):
         """Fused K|V GroupCast: one collective for both tensors (the
         reference's asymmetric-KV comm fuses along head_dim the same way,
         comm_meta.py:588-591 — valid for any d_k/d_v since rows coincide)."""
         if k.dtype == v.dtype and k.shape[1] == v.shape[1]:
             kv = jnp.concatenate([k, v], axis=-1)
-            kv_r = self._cast(kv, ops)
+            kv_r = self._cast(kv, ops, stage)
             return kv_r[..., : k.shape[-1]], kv_r[..., k.shape[-1]:]
-        return self._cast(k, ops), self._cast(v, ops)
+        return self._cast(k, ops, stage), self._cast(v, ops, stage)
 
     @property
     def backend(self) -> str:
@@ -358,8 +371,8 @@ class DistAttnRuntime:
 
             def f(q, k, v, cast_ops, slices):
                 parts_k, parts_v = [k], [v]
-                for ops in cast_ops:
-                    kr, vr = self._cast_kv(k, v, ops)
+                for st, ops in enumerate(cast_ops):
+                    kr, vr = self._cast_kv(k, v, ops, st)
                     parts_k.append(kr)
                     parts_v.append(vr)
                 k_all = jnp.concatenate(parts_k, axis=0)
@@ -399,8 +412,8 @@ class DistAttnRuntime:
 
             def f(q, k, v, cast_ops, arrays):
                 kv_parts_k, kv_parts_v = [k], [v]
-                for ops in cast_ops:
-                    kr, vr = self._cast_kv(k, v, ops)
+                for st, ops in enumerate(cast_ops):
+                    kr, vr = self._cast_kv(k, v, ops, st)
                     kv_parts_k.append(kr)
                     kv_parts_v.append(vr)
                 k_all = jnp.concatenate(kv_parts_k, axis=0)
@@ -438,8 +451,8 @@ class DistAttnRuntime:
             # issue every stage's collective up front: no data dependence on
             # compute, XLA overlaps them with the host + earlier-stage kernels
             ks, vs = [k], [v]
-            for ops in cast_ops:
-                kr, vr = self._cast_kv(k, v, ops)
+            for st, ops in enumerate(cast_ops):
+                kr, vr = self._cast_kv(k, v, ops, st)
                 ks.append(kr)
                 vs.append(vr)
             arrays_list = (tuple(a[0] for a in host_arrays),) + tuple(

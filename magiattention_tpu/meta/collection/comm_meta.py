@@ -27,27 +27,63 @@ from ...common.ranges import AttnRanges
 
 @dataclass
 class GroupCollectiveArg:
-    """One GroupCast stage over the whole mesh."""
+    """One GroupCast stage over the whole mesh.
+
+    Two interchangeable wire lowerings are planned host-side and the cheaper
+    one is picked per stage (``lowering``):
+
+    - ``a2a``: dense equal-split ``jax.lax.all_to_all`` — every (src,dst)
+      pair padded to ``a_cap`` (max pair rows). Wire rows/rank = cp * a_cap.
+    - ``ppermute``: one ``jax.lax.ppermute`` round per active ring distance
+      delta, each padded only to that distance's max pair (``pp_caps``).
+      Wire rows/rank = sum(pp_caps). For skewed masks (causal) this is the
+      TPU counterpart of the reference's true per-pair a2av split sizes
+      (magi_attention/comm/primitive/grpcoll/utils.py:593) — near
+      zero-redundant instead of cp x max-pair.
+    """
 
     # [dst][src] -> global k ranges src sends to dst (the transfer table,
     # ref meta/container/transfer_table.py)
     transfer_table: list[list[AttnRanges]]
-    # lowering arrays
+    # a2a lowering arrays
     send_idx: np.ndarray  # (cp, cp, A) int32 — [src][dst] local row indices
     send_counts: np.ndarray  # (cp, cp) int32
     recv_sel: np.ndarray  # (cp, R_max) int32 — [dst] flat src*A+pos selects
     recv_len: np.ndarray  # (cp,) int32 — valid rows per dst
     a_cap: int  # per-pair aligned capacity A
     r_max: int  # padded receive length
+    # ppermute lowering arrays (None when cp == 1 / no remote traffic)
+    pp_deltas: tuple[int, ...] = ()  # active ring distances (1..cp-1)
+    pp_caps: tuple[int, ...] = ()  # per-delta aligned capacity
+    pp_send_idx: np.ndarray | None = None  # (cp, sum_caps) int32
+    pp_recv_sel: np.ndarray | None = None  # (cp, R_max) int32
+    lowering: str = "a2a"  # chosen wire lowering for this stage
 
     def total_send_rows(self) -> int:
         return int(self.send_counts.sum())
 
     def comm_volume_bytes(self, row_bytes: int) -> int:
         """Payload actually needed (excludes alignment padding)."""
+        return self.payload_rows() * row_bytes
+
+    def payload_rows(self) -> int:
+        """True off-diagonal payload rows (whole mesh)."""
         off_diag = self.send_counts.copy()
         np.fill_diagonal(off_diag, 0)
-        return int(off_diag.sum()) * row_bytes
+        return int(off_diag.sum())
+
+    def wire_rows(self, lowering: str | None = None) -> int:
+        """Rows crossing the wire (whole mesh) under a lowering, padding
+        included — the denominator of the zero-redundancy claim."""
+        cp = self.send_counts.shape[0]
+        if (lowering or self.lowering) == "ppermute":
+            return cp * int(sum(self.pp_caps))
+        return cp * cp * self.a_cap
+
+    def wire_ratio(self) -> float:
+        """wire/payload under the chosen lowering (1.0 = zero-redundant)."""
+        payload = self.payload_rows()
+        return self.wire_rows() / payload if payload else 1.0
 
 
 @dataclass

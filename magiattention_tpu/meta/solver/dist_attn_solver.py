@@ -365,7 +365,41 @@ class DistAttnSolver:
             if flat:
                 recv_sel[d, : len(flat)] = flat
 
-        return GroupCollectiveArg(
+        # ppermute lowering: one ring round per active distance delta, each
+        # padded only to that distance's max pair — near zero-redundant for
+        # skewed traffic (the TPU analogue of true per-pair a2av splits,
+        # ref comm/primitive/grpcoll/utils.py:593)
+        pp_align = min(self.split_alignment, 8)
+        deltas, caps = [], []
+        for delta in range(1, cp):
+            mx = max(len(send_rows[s][(s + delta) % cp]) for s in range(cp))
+            if mx > 0:
+                deltas.append(delta)
+                caps.append(_round_up(mx, pp_align))
+        sum_caps = sum(caps)
+        pp_send_idx = pp_recv_sel = None
+        if sum_caps:
+            cum = {}
+            off = 0
+            for delta, c in zip(deltas, caps):
+                cum[delta] = off
+                off += c
+            pp_send_idx = np.zeros((cp, sum_caps), dtype=np.int32)
+            for s in range(cp):
+                for delta in deltas:
+                    rows = send_rows[s][(s + delta) % cp]
+                    if rows:
+                        pp_send_idx[s, cum[delta]: cum[delta] + len(rows)] = rows
+            pp_recv_sel = np.zeros((cp, r_max), dtype=np.int32)
+            for d in range(cp):
+                flat = []
+                for src, start_pos, n in recv_parts[d]:
+                    base = cum[(d - src) % cp]
+                    flat.extend(base + start_pos + i for i in range(n))
+                if flat:
+                    pp_recv_sel[d, : len(flat)] = flat
+
+        arg = GroupCollectiveArg(
             transfer_table=transfer_table,
             send_idx=send_idx,
             send_counts=send_counts,
@@ -373,7 +407,14 @@ class DistAttnSolver:
             recv_len=recv_len,
             a_cap=a_cap,
             r_max=r_max,
+            pp_deltas=tuple(deltas),
+            pp_caps=tuple(caps),
+            pp_send_idx=pp_send_idx,
+            pp_recv_sel=pp_recv_sel,
         )
+        if sum_caps and arg.wire_rows("ppermute") < arg.wire_rows("a2a"):
+            arg.lowering = "ppermute"
+        return arg
 
 
 def _local_to_global(own: AttnRanges, local_pos: int) -> int:
