@@ -1,0 +1,156 @@
+"""Magi-1 spatiotemporal video mask end-to-end (BASELINE config 4,
+VERDICT r1 item 6).
+
+Two tiers:
+- full-compute CP=8 pipeline vs the dense reference at a CI-feasible size
+  (interpret-mode kernels on the CPU mesh);
+- planning-only at the real 131k/CP=8 scale: the comm/calc plan must build
+  within budget, reconstruct the mask exactly, and stay near
+  zero-redundant on the wire.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu.api import (
+    calc_attn,
+    dispatch,
+    magi_attn_flex_key,
+    undispatch,
+)
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.config import DistAttnConfig, OverlapConfig
+from magiattention_tpu.meta import (
+    make_attn_meta_from_dispatch_meta,
+    make_dispatch_meta_from_qk_ranges,
+)
+from magiattention_tpu.testing import assert_close, ref_attn
+from magiattention_tpu.utils.sparse_utils import (
+    block_mask_to_dense_mask,
+    block_mask_to_ranges,
+    make_video_block_mask,
+)
+
+CP = 8
+
+
+def video_slices(num_frames, frame_tokens, block):
+    bm = make_video_block_mask(
+        num_frames, frame_tokens // block, window_frames=2
+    )
+    qr, kr, tm = block_mask_to_ranges(bm, block, block)
+    return bm, qr, kr, [t.to_int_type() for t in tm]
+
+
+def test_video_mask_cp8_pipeline():
+    """Full compute at 8 frames x 2048 tokens (16k total), CP=8, bf16."""
+    frames, frame_tokens, block = 8, 2048, 256
+    S = frames * frame_tokens
+    bm, qr, kr, tm = video_slices(frames, frame_tokens, block)
+    mesh = Mesh(np.array(jax.devices("cpu")[:CP]), ("cp",))
+    key = magi_attn_flex_key(
+        [[r.start, r.end] for r in qr],
+        [[r.start, r.end] for r in kr],
+        tm, S, S, mesh=mesh, cp_axis="cp", chunk_size=512,
+    )
+    rng = np.random.default_rng(0)
+    H, HK, D = 2, 1, 64
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.bfloat16)
+
+    def fwd(q, k, v):
+        q_d = dispatch(q, key)
+        k_d = dispatch(k, key, role="kv")
+        v_d = dispatch(v, key, role="kv")
+        out_d, meta = calc_attn(q_d, k_d, v_d, key)
+        return undispatch(out_d, key), undispatch(meta.lse, key)
+
+    out, lse = jax.jit(fwd)(q, k, v)
+    mask = block_mask_to_dense_mask(bm, block, block)
+    ro, rlse = ref_attn(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        mask, compute_dtype=jnp.float32,
+    )
+    assert_close(out, ro, atol=3e-2, rtol=3e-2, norm_rtol=2e-2,
+                 mismatch_thres=0.01, msg="video out")
+    assert_close(lse, rlse, atol=3e-2, rtol=3e-2, norm_rtol=2e-2,
+                 mismatch_thres=0.01, msg="video lse")
+
+
+def test_video_mask_131k_planning():
+    """BASELINE config 4 scale: 131072 tokens, CP=8 — plan must build fast,
+    reconstruct the block mask exactly, and be near zero-redundant."""
+    frames, frame_tokens, block = 8, 16384, 1024
+    S = frames * frame_tokens
+    assert S == 131072
+    bm, qr, kr, tm_types = video_slices(frames, frame_tokens, block)
+    from magiattention_tpu.common.enum import AttnMaskType
+
+    tm = [AttnMaskType.from_int_type(t) for t in tm_types]
+
+    t0 = time.perf_counter()
+    meta_q, meta_kv, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, tm, S, S, S // 256, CP,
+    )
+    comm_meta, calc_meta = make_attn_meta_from_dispatch_meta(
+        bucket, meta_q, DistAttnConfig(overlap_config=OverlapConfig(degree=1))
+    )
+    dt = time.perf_counter() - t0
+    assert dt < 30.0, f"131k video planning took {dt:.1f}s"
+
+    # wire volume near zero-redundant
+    payload = sum(s.payload_rows() for s in comm_meta.kv_stages)
+    wire = sum(s.wire_rows() for s in comm_meta.kv_stages)
+    assert payload > 0
+    assert wire / payload <= 1.3, f"wire ratio {wire / payload:.2f}"
+
+    # per-rank merged plans must reconstruct the video mask exactly at
+    # block granularity (sampled rows to keep CI fast)
+    pos = meta_q.position_ids
+    shard = calc_meta.shard_len
+    dense_bm = bm  # (nqb, nkb) block-level truth
+    rng = np.random.default_rng(1)
+    for r in range(0, CP, 3):
+        col_gid = np.full(
+            shard + sum(calc_meta.recv_len_per_stage), -1, dtype=np.int64
+        )
+        col_gid[:shard] = pos[r]
+        base = shard
+        for st, stage in enumerate(comm_meta.kv_stages):
+            off = 0
+            for src in range(CP):
+                for g in stage.transfer_table[r][src]:
+                    col_gid[base + off: base + off + g.seqlen] = np.arange(
+                        g.start, g.end
+                    )
+                    off += g.seqlen
+            base += calc_meta.recv_len_per_stage[st]
+
+        arg = calc_meta.merged_args[r]
+        # sample 16 local q rows; check their attended global column sets
+        sample = rng.choice(shard, size=16, replace=False)
+        attended = {int(i): set() for i in sample}
+        for i in range(arg.num_slices):
+            qs, qe = arg.q_ranges[i]
+            ks, ke = arg.k_ranges[i]
+            lo, hi = int(arg.d_lo[i]), int(arg.d_hi[i])
+            for qi in sample:
+                if qs <= qi < qe:
+                    for kj in range(ks, ke):
+                        if lo <= kj - qi <= hi:
+                            attended[int(qi)].add(int(col_gid[kj]))
+        for qi in sample:
+            gq = int(pos[r][qi])
+            expect = set()
+            qb = gq // block
+            for kb in np.nonzero(dense_bm[qb])[0]:
+                expect.update(range(int(kb) * block, (int(kb) + 1) * block))
+            assert attended[int(qi)] == expect, (
+                f"rank {r} q row {gq}: attended set mismatch"
+            )
