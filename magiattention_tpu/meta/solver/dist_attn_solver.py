@@ -282,10 +282,16 @@ class DistAttnSolver:
             recv_len_per_stage=stage_recv_len,
             kv_shard_len=kv_shard_len,
         )
-        return (
-            CommMeta(kv_stages=kv_stages, kv_host_ranges=list(kv_ranges)),
-            calc_meta,
+        comm_meta = CommMeta(
+            kv_stages=kv_stages, kv_host_ranges=list(kv_ranges)
         )
+        from ...env.general import is_sanity_check_enable
+
+        if is_sanity_check_enable():
+            _sanity_check_plan(
+                comm_meta, calc_meta, kv_ranges, self.bucket, meta
+            )
+        return comm_meta, calc_meta
 
     # ------------------------------------------------------------------
 
@@ -500,3 +506,90 @@ def _find_interval(
         if grange.is_subrange_of(iv.grange):
             return iv
     raise ValueError(f"no merged interval contains {grange}")
+
+
+def _arg_area(arg) -> int:
+    """Total attention area of an AttnArg's band slices."""
+    total = 0
+    for i in range(arg.num_slices):
+        total += band_area(
+            int(arg.q_ranges[i][0]), int(arg.q_ranges[i][1]),
+            int(arg.k_ranges[i][0]), int(arg.k_ranges[i][1]),
+            int(arg.d_lo[i]), int(arg.d_hi[i]),
+        )
+    return total
+
+
+def _sanity_check_plan(
+    comm_meta: CommMeta,
+    calc_meta: CalcMeta,
+    kv_ranges: list[AttnRanges],
+    bucket: AttnBucket,
+    meta: DispatchMeta,
+) -> None:
+    """Expensive plan invariants behind MAGI_ATTENTION_SANITY_CHECK=1
+    (ref env/general.py:75-84; e.g. grpcoll/utils.py:294 meta-arg checks).
+
+    Validates: transfer-table <-> send-count symmetry and ownership,
+    receive-buffer lengths/bounds (both wire lowerings), slice extents, and
+    the merged-area identity (merged == host + sum of remote stages).
+    """
+    cp = len(calc_meta.host_args)
+
+    for st, s in enumerate(comm_meta.kv_stages):
+        cp_t = len(s.transfer_table)
+        assert cp_t == cp, f"stage {st}: transfer table size {cp_t} != {cp}"
+        for dst in range(cp):
+            recv_rows = 0
+            for src in range(cp):
+                rows = s.transfer_table[dst][src].total_seqlen
+                recv_rows += rows
+                # table <-> send_counts symmetry
+                assert rows == int(s.send_counts[src, dst]), (
+                    f"stage {st}: transfer_table[{dst}][{src}]={rows} rows "
+                    f"!= send_counts[{src},{dst}]={int(s.send_counts[src, dst])}"
+                )
+                # every transferred range is owned by its source
+                for g in s.transfer_table[dst][src]:
+                    assert any(
+                        g.is_subrange_of(own) for own in kv_ranges[src]
+                    ), f"stage {st}: {g} not owned by src {src}"
+            assert recv_rows == int(s.recv_len[dst]) <= s.r_max, (
+                f"stage {st} dst {dst}: recv rows {recv_rows} != "
+                f"recv_len {int(s.recv_len[dst])} (r_max {s.r_max})"
+            )
+        # lowering index arrays in bounds
+        assert s.send_idx.max(initial=0) < max(calc_meta.kv_shard_len, 1), (
+            f"stage {st}: send_idx beyond kv shard"
+        )
+        assert s.recv_sel.max(initial=0) < cp * s.a_cap
+        if s.pp_recv_sel is not None:
+            assert s.pp_recv_sel.max(initial=0) < sum(s.pp_caps)
+
+    # slice extents + area identity per rank
+    for r in range(cp):
+        for name, arg in (
+            ("host", calc_meta.host_args[r]),
+            ("merged", calc_meta.merged_args[r]),
+            *(
+                (f"remote{st}", calc_meta.remote_args_per_stage[st][r])
+                for st in range(len(calc_meta.remote_args_per_stage))
+            ),
+        ):
+            if arg.num_slices:
+                assert arg.q_ranges.min() >= 0 and arg.k_ranges.min() >= 0
+                assert arg.q_ranges.max() <= arg.total_seqlen_q, (
+                    f"rank {r} {name}: q slice beyond extent"
+                )
+                assert arg.k_ranges.max() <= arg.total_seqlen_k, (
+                    f"rank {r} {name}: k slice beyond extent"
+                )
+        merged = _arg_area(calc_meta.merged_args[r])
+        host = _arg_area(calc_meta.host_args[r])
+        remote = sum(
+            _arg_area(calc_meta.remote_args_per_stage[st][r])
+            for st in range(len(calc_meta.remote_args_per_stage))
+        )
+        assert merged == host + remote, (
+            f"rank {r}: merged area {merged} != host {host} + remote {remote}"
+        )

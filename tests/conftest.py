@@ -18,6 +18,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["MAGI_ATTENTION_PALLAS_INTERPRET"] = "1"
+# run the whole suite with the expensive plan invariants on (ref
+# MAGI_ATTENTION_SANITY_CHECK, env/general.py:75-84)
+os.environ.setdefault("MAGI_ATTENTION_SANITY_CHECK", "1")
 
 import jax  # noqa: E402
 
