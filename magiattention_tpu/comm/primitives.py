@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def group_cast_rows(
@@ -121,6 +122,105 @@ def group_cast_rows_pp(
     return jnp.take(buf, pp_recv_sel, axis=0)
 
 
+def group_cast_rows_ragged(
+    x: jax.Array,
+    send_row_idx: jax.Array,
+    input_offsets: jax.Array,
+    send_sizes: jax.Array,
+    output_offsets: jax.Array,
+    recv_sizes: jax.Array,
+    r_cap: int,
+    axis_name: str,
+) -> jax.Array:
+    """GroupCast over ``jax.lax.ragged_all_to_all`` — true per-pair split
+    sizes, zero padding on the wire (the TPU counterpart of the reference's
+    native grpcoll kernels, csrc/comm/grpcoll/; splits per
+    grpcoll/utils.py:593). TPU-only (XLA:CPU lacks the op); the receive
+    buffer comes out directly in the solver's (src asc, range asc) layout,
+    so no post-gather is needed.
+
+    Args (per-rank views inside shard_map):
+        send_row_idx: ``(send_cap,)`` local rows concatenated by destination.
+        input_offsets/send_sizes: ``(cp,)`` my outgoing segment layout.
+        output_offsets: ``(cp,)`` where my segment lands at each destination.
+        recv_sizes: ``(cp,)`` rows I receive from each source.
+    """
+    send = jnp.take(x, send_row_idx, axis=0)
+    out = jnp.zeros((r_cap, *x.shape[1:]), x.dtype)
+    return jax.lax.ragged_all_to_all(
+        send, out, input_offsets, send_sizes, output_offsets, recv_sizes,
+        axis_name=axis_name,
+    )
+
+
+def all_to_all_v(
+    x: jax.Array,
+    input_offsets: jax.Array,
+    send_sizes: jax.Array,
+    output_offsets: jax.Array,
+    recv_sizes: jax.Array,
+    out_cap: int,
+    axis_name: str,
+) -> jax.Array:
+    """Variable-split all-to-all (ref comm/primitive/_all2all_v.py:111).
+
+    True variable splits via ragged_all_to_all. TPU-only; on CPU use the
+    padded :func:`group_cast_rows` lowering instead.
+    """
+    out = jnp.zeros((out_cap, *x.shape[1:]), x.dtype)
+    return jax.lax.ragged_all_to_all(
+        x, out, input_offsets, send_sizes, output_offsets, recv_sizes,
+        axis_name=axis_name,
+    )
+
+
 def all_gather_v(x: jax.Array, axis_name: str) -> jax.Array:
     """Gather all shards along axis 0 (equal shard sizes). Inside shard_map."""
     return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def all_gather_vv(
+    x: jax.Array,
+    sizes: tuple[int, ...],
+    rank_sizes: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Variable-size all-gather (ref _all_gather_v.py): each rank holds
+    ``sizes[rank]`` valid rows in its padded shard; returns the compacted
+    concat of all ranks' valid rows (statically known sizes -> static
+    compaction; portable on every backend).
+
+    Args:
+        sizes: per-rank valid row counts (host-static).
+        rank_sizes: unused placeholder for API symmetry (may be None).
+    """
+    gathered = jax.lax.all_gather(x, axis_name, axis=0)  # (cp, pad, ...)
+    shard_pad = x.shape[0]
+    sel = np.concatenate(
+        [r * shard_pad + np.arange(n, dtype=np.int64)
+         for r, n in enumerate(sizes)]
+    ) if any(sizes) else np.zeros(0, dtype=np.int64)
+    flat = gathered.reshape(len(sizes) * shard_pad, *x.shape[1:])
+    return jnp.take(flat, jnp.asarray(sel, dtype=jnp.int32), axis=0)
+
+
+def scatter_v(
+    x: jax.Array,
+    sizes: tuple[int, ...],
+    axis_name: str,
+    pad_to: int | None = None,
+) -> jax.Array:
+    """Variable-size scatter of a replicated concat buffer (ref
+    _scatter_v.py): rank r gets rows [offset[r], offset[r]+sizes[r]) padded
+    to ``pad_to`` (default: max size). Portable: static slice per rank."""
+    offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    cap = pad_to or (max(sizes) if sizes else 1)
+    r = jax.lax.axis_index(axis_name)
+    # static gather matrix: (cp, cap) row selectors, padded with repeats of
+    # the segment start (receivers ignore rows beyond their size)
+    sel = np.zeros((len(sizes), cap), dtype=np.int32)
+    for i, n in enumerate(sizes):
+        take_n = np.arange(cap, dtype=np.int64)
+        take_n = np.minimum(take_n, max(n - 1, 0)) + offs[i]
+        sel[i] = take_n.astype(np.int32)
+    return jnp.take(x, jnp.asarray(sel)[r], axis=0)

@@ -28,3 +28,23 @@ def is_bwd_high_precision_reduce_enable() -> bool:
 def split_alignment() -> int:
     """Pad collective split sizes to a multiple of this (TPU lane alignment)."""
     return _get_int("MAGI_ATTENTION_SPLIT_ALIGNMENT", 128)
+
+
+def is_ragged_grpcoll_enable() -> bool:
+    """Use ``jax.lax.ragged_all_to_all`` for GroupCast — true per-pair split
+    sizes, zero padding on the wire (the TPU counterpart of the reference's
+    native grpcoll kernel tier, csrc/comm/grpcoll/). Default: auto — on when
+    the backend supports the op (TPU), off on CPU (XLA:CPU lacks it)."""
+    import os
+
+    v = os.environ.get("MAGI_ATTENTION_RAGGED_GRPCOLL", "auto").lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend init failure: fall back to portable tiers
+        return False

@@ -136,6 +136,41 @@ def _multi_ffa_bwd(params_list, res, cts):
 _multi_ffa.defvjp(_multi_ffa_fwd, _multi_ffa_bwd)
 
 
+def _ragged_arrays(s) -> tuple[jax.Array, ...]:
+    """Whole-mesh arrays for the ragged_all_to_all GroupCast tier, derived
+    from a stage's a2a plan (true per-pair sizes; the receive buffer lands
+    directly in the solver's src-asc layout).
+
+    Returns (send_row_idx (cp, send_cap), input_offsets (cp, cp),
+    send_sizes (cp, cp), output_offsets (cp, cp), recv_sizes (cp, cp))."""
+    counts = s.send_counts.astype(np.int64)  # [src][dst]
+    cp = counts.shape[0]
+    send_tot = counts.sum(axis=1)
+    send_cap = max(int(send_tot.max()), 1)
+    send_row_idx = np.zeros((cp, send_cap), dtype=np.int32)
+    input_offsets = np.zeros((cp, cp), dtype=np.int32)
+    for src in range(cp):
+        off = 0
+        for dst in range(cp):
+            n = int(counts[src, dst])
+            input_offsets[src, dst] = off
+            if n:
+                send_row_idx[src, off: off + n] = s.send_idx[src, dst, :n]
+                off += n
+    # [src][dst]: where src's segment lands at dst = sum of earlier sources
+    output_offsets = (
+        np.cumsum(counts, axis=0) - counts
+    ).astype(np.int32)
+    recv_sizes = counts.T.astype(np.int32)  # [dst][src]
+    return (
+        jnp.asarray(send_row_idx),
+        jnp.asarray(input_offsets),
+        jnp.asarray(s.send_counts.astype(np.int32)),
+        jnp.asarray(output_offsets),
+        jnp.asarray(recv_sizes),
+    )
+
+
 def _stack_plans(args: list[AttnArg], sq: int, sk: int, bq: int, bk: int):
     """Per-rank FFA plans -> rank-stacked arrays padded to a common size."""
     plans = [
@@ -248,10 +283,14 @@ class DistAttnRuntime:
             self._cast_ops = self._hier_arrays
             self._cast_kinds = [("hier",)] * len(self._hier_arrays)
         else:
+            use_ragged = env_comm.is_ragged_grpcoll_enable()
             self._cast_ops = []
             self._cast_kinds = []
             for s in cm.kv_stages:
-                if s.lowering == "ppermute":
+                if use_ragged:
+                    self._cast_ops.append(_ragged_arrays(s))
+                    self._cast_kinds.append(("ragged", s.r_max))
+                elif s.lowering == "ppermute":
                     self._cast_ops.append(
                         (jnp.asarray(s.pp_send_idx), jnp.asarray(s.pp_recv_sel))
                     )
@@ -281,6 +320,13 @@ class DistAttnRuntime:
                 dcn_axis, ici_axis,
             )
         kind = self._cast_kinds[stage]
+        if kind[0] == "ragged":
+            from ..comm.primitives import group_cast_rows_ragged
+
+            return group_cast_rows_ragged(
+                x, ops[0][0], ops[1][0], ops[2][0], ops[3][0], ops[4][0],
+                kind[1], self.cp_axis,
+            )
         if kind[0] == "pp":
             return group_cast_rows_pp(
                 x, ops[0][0], ops[1][0], kind[1], kind[2],

@@ -128,6 +128,47 @@ def test_pp_receive_buffer_matches_a2a(case, overlap_degree):
             )
 
 
+def test_ragged_arrays_match_a2a_layout():
+    """The ragged_all_to_all tier (TPU-only op) must land segments exactly
+    where the solver's receive layout expects them. XLA:CPU lacks the op,
+    so validate the planned offsets by simulating its semantics in numpy
+    against the a2a path's assembled buffer."""
+    from magiattention_tpu.functional.dist_attn import _ragged_arrays
+
+    comm_meta, calc_meta = make_comm_meta("sliding_window", s=2048, chunk=64)
+    kv_shard = calc_meta.kv_shard_len
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((CP, kv_shard, 4)).astype(np.float32)
+
+    for stage in comm_meta.kv_stages:
+        send_row_idx, in_off, send_sz, out_off, recv_sz = (
+            np.asarray(a) for a in _ragged_arrays(stage)
+        )
+        # simulate ragged_all_to_all: src sends its dst-segment of the
+        # gathered send buffer; it lands at out_off[src, dst] at the dst
+        ragged = np.zeros((CP, stage.r_max, 4), dtype=np.float32)
+        for src in range(CP):
+            send = x[src][send_row_idx[src]]
+            for dst in range(CP):
+                n = int(send_sz[src, dst])
+                if n:
+                    seg = send[in_off[src, dst]: in_off[src, dst] + n]
+                    ragged[dst, out_off[src, dst]: out_off[src, dst] + n] = seg
+        # a2a reference: dense (cp, a_cap) exchange + recv_sel gather
+        for dst in range(CP):
+            n = int(stage.recv_len[dst])
+            flat = np.zeros((CP * stage.a_cap, 4), dtype=np.float32)
+            for src in range(CP):
+                c = int(stage.send_counts[src, dst])
+                rows = stage.send_idx[src, dst, :c]
+                flat[src * stage.a_cap: src * stage.a_cap + c] = x[src][rows]
+            expect = flat[stage.recv_sel[dst, :n]]
+            np.testing.assert_array_equal(
+                ragged[dst, :n], expect,
+                err_msg=f"ragged layout mismatch (dst {dst})",
+            )
+
+
 def test_pp_group_reduce_is_transpose():
     """AD through group_cast_rows_pp must equal the explicit a2a reduce."""
     comm_meta, calc_meta = make_comm_meta("causal")
