@@ -202,3 +202,94 @@ def train_step(
     )
     params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
     return params, loss
+
+
+# ---------------------------------------------------------------------------
+# dense (non-CP) twin + optax integration — the convergence-parity artifact
+# (ref examples/torch_native convergence evidence; VERDICT r1 item 10)
+# ---------------------------------------------------------------------------
+
+
+def forward_dense(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Same network, replicated dense attention over an explicit boolean
+    mask — the single-device twin used to check CP convergence parity."""
+    dt = cfg.jdtype
+    s = tokens.shape[0]
+    x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
+    pos = jnp.arange(s, dtype=jnp.int32)
+
+    for lyr in params["layers"]:
+        h = _rms_norm(x, lyr["attn_norm"], cfg.norm_eps)
+        q = (h @ lyr["wq"].astype(dt)).reshape(-1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lyr["wk"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lyr["wv"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        g = cfg.n_heads // cfg.n_kv_heads
+        kf = jnp.repeat(k, g, axis=1)
+        vf = jnp.repeat(v, g, axis=1)
+        logits = jnp.einsum(
+            "shd,thd->hst", q.astype(jnp.float32), kf.astype(jnp.float32)
+        ) * (cfg.head_dim ** -0.5)
+        logits = jnp.where(mask[None], logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        attn_out = jnp.einsum("hst,thd->shd", p, vf.astype(jnp.float32))
+        attn_out = attn_out.astype(dt).reshape(-1, cfg.n_heads * cfg.head_dim)
+        x = x + attn_out @ lyr["wo"].astype(dt)
+
+        h = _rms_norm(x, lyr["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lyr["w_gate"].astype(dt))
+        up = h @ lyr["w_up"].astype(dt)
+        x = x + (gate * up) @ lyr["w_down"].astype(dt)
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def loss_fn_dense(params, cfg, tokens, labels, mask):
+    logits = forward_dense(params, cfg, tokens, mask)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, jnp.maximum(labels, 0)[:, None], axis=-1
+    )[:, 0]
+    valid = labels >= 0
+    return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+        jnp.sum(valid), 1
+    )
+
+
+def make_optax_train_step(cfg: LlamaConfig, attn_key, optimizer):
+    """jitted optax train step on the CP model (ref examples/torch_native
+    optimizer loop). ``optimizer`` is any optax GradientTransformation."""
+    import optax
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, cfg, tokens, labels, attn_key
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_optax_train_step_dense(cfg: LlamaConfig, mask, optimizer):
+    """The dense twin of :func:`make_optax_train_step` (same optimizer)."""
+    import optax
+
+    mask = jnp.asarray(mask)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn_dense)(
+            params, cfg, tokens, labels, mask
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

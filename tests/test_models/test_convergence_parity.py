@@ -1,0 +1,83 @@
+"""CP-vs-dense convergence parity with an optax trainer (VERDICT r1 item 10;
+ref examples/torch_native + examples/transformers loss-curve evidence).
+
+Two identical models from the same init, same data stream, same AdamW:
+one trains with MagiAttention CP over a 4-device mesh, the other with
+replicated dense attention. Loss trajectories must track each other to
+floating-point noise."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from magiattention_tpu.api import magi_attn_flex_key
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.models import LlamaConfig, init_params
+from magiattention_tpu.models.llama import (
+    make_optax_train_step,
+    make_optax_train_step_dense,
+    shard_params,
+)
+
+S = 256
+CP = 4
+CFG = LlamaConfig(
+    vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    head_dim=16, ffn_hidden=128, dtype="float32",
+)
+QR = [[0, 96], [96, S]]
+KR = [[0, 96], [96, S]]
+TM = [1, 1]
+STEPS = 8
+
+
+def data_stream(step):
+    rng = np.random.default_rng(1000 + step)
+    tokens = rng.integers(0, CFG.vocab_size, S).astype(np.int32)
+    labels = np.concatenate([tokens[1:], [-1]]).astype(np.int32)
+    return jnp.asarray(tokens), jnp.asarray(labels)
+
+
+def test_optax_convergence_matches_dense():
+    mesh = Mesh(np.array(jax.devices("cpu")[:CP]), axis_names=("cp",))
+    key = magi_attn_flex_key(
+        QR, KR, TM, S, S, mesh=mesh, cp_axis="cp", chunk_size=16
+    )
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges(QR), AttnRanges.from_ranges(KR),
+        [AttnMaskType.from_int_type(t) for t in TM],
+        total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+
+    optimizer = optax.adamw(3e-3)
+
+    params_cp = init_params(CFG, jax.random.key(7))
+    params_dense = jax.tree.map(jnp.copy, params_cp)
+    params_cp = shard_params(params_cp, mesh, "cp")
+
+    step_cp = make_optax_train_step(CFG, key, optimizer)
+    step_dense = make_optax_train_step_dense(CFG, mask, optimizer)
+
+    opt_cp = optimizer.init(params_cp)
+    opt_dense = optimizer.init(params_dense)
+
+    losses_cp, losses_dense = [], []
+    for i in range(STEPS):
+        tokens, labels = data_stream(i)
+        params_cp, opt_cp, l_cp = step_cp(params_cp, opt_cp, tokens, labels)
+        params_dense, opt_dense, l_d = step_dense(
+            params_dense, opt_dense, tokens, labels
+        )
+        losses_cp.append(float(l_cp))
+        losses_dense.append(float(l_d))
+
+    losses_cp = np.array(losses_cp)
+    losses_dense = np.array(losses_dense)
+    # training must actually make progress...
+    assert losses_dense[-1] < losses_dense[0]
+    # ...and the two curves must track each other
+    np.testing.assert_allclose(losses_cp, losses_dense, rtol=2e-3, atol=2e-3)
