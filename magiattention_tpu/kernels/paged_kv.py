@@ -1,0 +1,167 @@
+"""Paged KV cache + paged attention (ref: magi_attention/kernel/cutedsl/paged_kv.py).
+
+Inference-oriented: K/V live in fixed-size pages indexed by a per-sequence
+page table, so cache memory is allocated in page granularity instead of
+max-seqlen rectangles. TPU-native design decisions (vs the reference's
+CuTe-DSL gather-in-kernel):
+
+- pages are gathered with ONE ``jnp.take`` over the page axis (a single
+  large HBM gather XLA lays out well) into the contiguous ``[sk, hk, d]``
+  layout the FFA kernel already consumes — no separate paged kernel to
+  maintain, and every mask type / GQA / softcap feature works unchanged;
+- the cache is a pytree of arrays updated functionally (``.at[].set``), so
+  it jits and shards like any other state (e.g. pages sharded over a mesh
+  axis for long-context serving).
+
+Static-shape contract: ``max_pages_per_seq`` bounds the gather; rows beyond
+``length`` are masked via the slice metadata (an INVCAUSAL-free band with
+``ke = length``), which the plan encodes exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PagedKVCache:
+    """Paged KV storage for one attention layer.
+
+    Attributes:
+        k_pages / v_pages: ``(num_pages, page_size, hk, d)``.
+        page_table: ``(max_seqs, max_pages_per_seq)`` int32 page ids
+            (-1 = unallocated).
+        lengths: ``(max_seqs,)`` int32 tokens currently stored per sequence.
+    """
+
+    k_pages: jax.Array
+    v_pages: jax.Array
+    page_table: jax.Array
+    lengths: jax.Array
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[1]
+
+    @classmethod
+    def create(
+        cls,
+        num_pages: int,
+        page_size: int,
+        n_kv_heads: int,
+        head_dim: int,
+        max_seqs: int,
+        max_pages_per_seq: int,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        return cls(
+            k_pages=jnp.zeros(
+                (num_pages, page_size, n_kv_heads, head_dim), dtype
+            ),
+            v_pages=jnp.zeros(
+                (num_pages, page_size, n_kv_heads, head_dim), dtype
+            ),
+            page_table=jnp.full(
+                (max_seqs, max_pages_per_seq), -1, jnp.int32
+            ),
+            lengths=jnp.zeros((max_seqs,), jnp.int32),
+        )
+
+
+def assign_pages(
+    cache: PagedKVCache, seq_id: int, page_ids: np.ndarray
+) -> PagedKVCache:
+    """Host-side page allocation: install ``page_ids`` as seq's table."""
+    table = cache.page_table.at[seq_id, : len(page_ids)].set(
+        jnp.asarray(page_ids, jnp.int32)
+    )
+    return PagedKVCache(cache.k_pages, cache.v_pages, table, cache.lengths)
+
+
+def append_kv(
+    cache: PagedKVCache, seq_id, k_new: jax.Array, v_new: jax.Array
+) -> PagedKVCache:
+    """Append ``(t, hk, d)`` new rows to a sequence (pages pre-assigned).
+
+    ``t`` is static (typically 1 for decode, chunk for prefill); positions
+    are ``lengths[seq_id] .. +t``. Functional update — jit-safe.
+    """
+    t = k_new.shape[0]
+    start = cache.lengths[seq_id]
+    ps = cache.page_size
+    pos = start + jnp.arange(t, dtype=jnp.int32)
+    page_idx = cache.page_table[seq_id, pos // ps]  # (t,)
+    row = pos % ps
+
+    k_pages = cache.k_pages.at[page_idx, row].set(k_new)
+    v_pages = cache.v_pages.at[page_idx, row].set(v_new)
+    lengths = cache.lengths.at[seq_id].set(start + t)
+    return PagedKVCache(k_pages, v_pages, cache.page_table, lengths)
+
+
+def gather_kv(
+    cache: PagedKVCache, seq_id, max_pages: int | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """Gather a sequence's pages into contiguous ``(cap, hk, d)`` K/V
+    (cap = max_pages * page_size; rows beyond ``lengths[seq_id]`` are
+    whatever the unwritten pages hold and must be masked by the caller)."""
+    table = cache.page_table[seq_id]
+    if max_pages is not None:
+        table = table[:max_pages]
+    safe = jnp.maximum(table, 0)
+    k = jnp.take(cache.k_pages, safe, axis=0)  # (P, ps, hk, d)
+    v = jnp.take(cache.v_pages, safe, axis=0)
+    ps = cache.page_size
+    p = k.shape[0]
+    return (
+        k.reshape(p * ps, *k.shape[2:]),
+        v.reshape(p * ps, *v.shape[2:]),
+    )
+
+
+def paged_attn(
+    q: jax.Array,
+    cache: PagedKVCache,
+    seq_id: int,
+    q_start: int,
+    max_pages: int,
+    softmax_scale: float | None = None,
+    softcap: float = 0.0,
+    causal: bool = True,
+):
+    """Attention of ``q`` (``(t, hq, d)`` at positions ``q_start..+t``)
+    against a sequence's paged KV.
+
+    The valid-length mask is expressed as FFA slice metadata (band with
+    ``ke = kv_len``), so the Pallas kernel computes only real rows. The
+    kv length must be host-static per call (the plan parameterizes the
+    kernel grid) — standard for serving where lengths bucket into steps.
+
+    Returns (out ``(t, hq, dv)``, lse ``(t, hq)``).
+    """
+    from .ffa import ffa_attn
+
+    t = q.shape[0]
+    kv_len = int(q_start) + t  # tokens stored so far incl. this chunk
+    k, v = gather_kv(cache, seq_id, max_pages)
+    # one slice: q rows [0,t) at global positions [q_start, q_start+t)
+    # attending k rows [0, kv_len) with an optional causal band. In local
+    # coords the causal diagonal sits at offset q_start.
+    if causal:
+        d_lo, d_hi = -(1 << 30), int(q_start)
+    else:
+        d_lo, d_hi = -(1 << 30), 1 << 30
+    return ffa_attn(
+        q, k, v,
+        q_ranges=[[0, t]],
+        k_ranges=[[0, kv_len]],
+        softmax_scale=softmax_scale,
+        softcap=softcap,
+        d_lo=np.array([d_lo], np.int32),
+        d_hi=np.array([d_hi], np.int32),
+    )

@@ -1,0 +1,87 @@
+"""Paged KV cache + paged attention vs the dense reference
+(ref kernel/cutedsl/paged_kv.py — VERDICT r1 missing item 8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from magiattention_tpu.kernels.paged_kv import (
+    PagedKVCache,
+    append_kv,
+    assign_pages,
+    gather_kv,
+    paged_attn,
+)
+from magiattention_tpu.testing import assert_close, ref_attn
+
+HK, HQ, D = 2, 4, 64
+PS = 16  # page size
+
+
+def build_cache(tokens_k, tokens_v, page_ids):
+    cache = PagedKVCache.create(
+        num_pages=32, page_size=PS, n_kv_heads=HK, head_dim=D,
+        max_seqs=2, max_pages_per_seq=8, dtype=jnp.float32,
+    )
+    cache = assign_pages(cache, 0, np.asarray(page_ids))
+    # append in uneven chunks crossing page boundaries
+    t = tokens_k.shape[0]
+    splits = [0, 7, PS, PS + 3, t]
+    for a, b in zip(splits[:-1], splits[1:]):
+        if b > a:
+            cache = append_kv(cache, 0, tokens_k[a:b], tokens_v[a:b])
+    return cache
+
+
+def test_append_and_gather_roundtrip():
+    rng = np.random.default_rng(0)
+    T = 3 * PS + 5
+    k_nat = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    v_nat = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    # non-contiguous page allocation on purpose
+    cache = build_cache(k_nat, v_nat, [5, 2, 11, 7])
+    assert int(cache.lengths[0]) == T
+    k, v = gather_kv(cache, 0, max_pages=4)
+    np.testing.assert_allclose(np.asarray(k[:T]), np.asarray(k_nat))
+    np.testing.assert_allclose(np.asarray(v[:T]), np.asarray(v_nat))
+
+
+def test_paged_decode_matches_dense():
+    rng = np.random.default_rng(1)
+    ctx = 2 * PS + 9  # context already in cache
+    k_nat = jnp.asarray(rng.standard_normal((ctx + 1, HK, D)), jnp.float32)
+    v_nat = jnp.asarray(rng.standard_normal((ctx + 1, HK, D)), jnp.float32)
+    cache = build_cache(k_nat[:ctx], v_nat[:ctx], [3, 9, 1, 12])
+
+    q = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+    # decode step: append the new token's kv then attend
+    cache = append_kv(cache, 0, k_nat[ctx:], v_nat[ctx:])
+    out, lse = paged_attn(q, cache, 0, q_start=ctx, max_pages=4)
+
+    mask = np.ones((1, ctx + 1), dtype=bool)  # one q row attends everything
+    ro, rlse = ref_attn(
+        q, k_nat, v_nat, mask, compute_dtype=jnp.float32
+    )
+    assert_close(out, ro, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
+    assert_close(lse, rlse, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
+
+
+def test_paged_prefill_chunk_matches_dense():
+    rng = np.random.default_rng(2)
+    ctx, t = PS + 3, 8  # chunked prefill: t new q rows
+    total = ctx + t
+    k_nat = jnp.asarray(rng.standard_normal((total, HK, D)), jnp.float32)
+    v_nat = jnp.asarray(rng.standard_normal((total, HK, D)), jnp.float32)
+    cache = build_cache(k_nat[:ctx], v_nat[:ctx], [4, 0, 8])
+    cache = append_kv(cache, 0, k_nat[ctx:], v_nat[ctx:])
+
+    q = jnp.asarray(rng.standard_normal((t, HQ, D)), jnp.float32)
+    out, lse = paged_attn(q, cache, 0, q_start=ctx, max_pages=3)
+
+    # causal over global positions ctx..ctx+t
+    mask = np.zeros((t, total), dtype=bool)
+    for i in range(t):
+        mask[i, : ctx + i + 1] = True
+    ro, rlse = ref_attn(q, k_nat, v_nat, mask, compute_dtype=jnp.float32)
+    assert_close(out, ro, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
+    assert_close(lse, rlse, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
