@@ -6,6 +6,14 @@ common/__init__.py:17-34). The set-algebra hot paths (merge / holes /
 overlaps / make-local) run in native code over (n,2) int32 buffers; scalar
 interval methods subclass the Python implementation (they are O(1) and not
 worth crossing the FFI for).
+
+Measured guidance (r2): at the 1M-token/1024-chunk planning scale the
+static solver's range lists stay SMALL (tens of entries), where per-call
+ctypes marshalling costs more than it saves — routing the solver through
+these classes measured 17.7s vs 8.3s for the pure-Python + bisect-index
+implementation. The solver therefore imports the Python classes directly;
+the C++ backend remains the package-root export for API users with large
+range lists and for protocol-conformance parity with the reference.
 """
 
 from __future__ import annotations
