@@ -152,3 +152,65 @@ class AttnMask:
             f"AttnMask(q={self.total_seqlen_q}, k={self.total_seqlen_k}, "
             f"area={self.area}, n_slices={len(self.q_ranges)})"
         )
+
+    def visualize(
+        self,
+        path: str | None = None,
+        max_cells: int = 64,
+        rank_of_row: np.ndarray | None = None,
+    ) -> str:
+        """Render the mask (ref common/mask.py:430 AttnMask.visualize).
+
+        Returns an ASCII rendering downsampled to at most ``max_cells`` per
+        side; when ``path`` is given, additionally writes a PNG (matplotlib,
+        best-effort). ``rank_of_row`` (optional, (total_seqlen_q,) int) tints
+        rows by owning CP rank — the dispatch-assignment view (ref
+        dynamic_solver_vis.py).
+        """
+        m = self.mask_array
+        sq, sk = m.shape
+        fq = max(1, -(-sq // max_cells))
+        fk = max(1, -(-sk // max_cells))
+        nq, nk = -(-sq // fq), -(-sk // fk)
+        pad = np.zeros((nq * fq, nk * fk), dtype=np.float32)
+        pad[:sq, :sk] = m
+        cells = pad.reshape(nq, fq, nk, fk).mean(axis=(1, 3))
+        shades = " .:#"
+        lines = []
+        for i in range(nq):
+            row = "".join(
+                shades[min(int(c * (len(shades) - 1) + 0.999), len(shades) - 1)]
+                for c in cells[i]
+            )
+            if rank_of_row is not None:
+                r = int(rank_of_row[min(i * fq, sq - 1)])
+                row += f"  r{r}"
+            lines.append(row)
+        text = "\n".join(lines)
+        if path is not None:
+            try:  # pragma: no cover - depends on matplotlib backend
+                import matplotlib
+
+                matplotlib.use("Agg")
+                import matplotlib.pyplot as plt
+
+                fig, ax = plt.subplots(figsize=(6, 6))
+                if rank_of_row is not None:
+                    img = np.where(
+                        m,
+                        rank_of_row[:, None].astype(np.float32) + 1.0,
+                        np.nan,
+                    )
+                    ax.imshow(img, aspect="auto", interpolation="nearest",
+                              cmap="tab20")
+                else:
+                    ax.imshow(m, aspect="auto", interpolation="nearest",
+                              cmap="Greys")
+                ax.set_xlabel("k")
+                ax.set_ylabel("q")
+                ax.set_title(repr(self))
+                fig.savefig(path, dpi=120, bbox_inches="tight")
+                plt.close(fig)
+            except Exception:
+                pass
+        return text

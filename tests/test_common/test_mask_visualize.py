@@ -1,0 +1,34 @@
+"""AttnMask.visualize parity (ref common/mask.py:430)."""
+
+import numpy as np
+
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+
+
+def test_visualize_ascii_and_png(tmp_path):
+    m = AttnMask.from_ranges(
+        AttnRanges.from_ranges([[0, 32], [32, 128]]),
+        AttnRanges.from_ranges([[0, 32], [0, 128]]),
+        [AttnMaskType.CAUSAL, AttnMaskType.BICAUSAL],
+        total_seqlen_q=128, total_seqlen_k=128,
+    )
+    txt = m.visualize(path=str(tmp_path / "m.png"), max_cells=16)
+    lines = txt.splitlines()
+    assert len(lines) == 16
+    # causal-ish: first line mostly empty at the right, diagonal advances
+    assert lines[0].strip() != "" and len(lines[0]) == 16
+    assert (tmp_path / "m.png").exists()
+
+
+def test_visualize_with_rank_tint():
+    m = AttnMask.from_ranges(
+        AttnRanges.from_ranges([[0, 64]]),
+        AttnRanges.from_ranges([[0, 64]]),
+        [AttnMaskType.CAUSAL],
+        total_seqlen_q=64, total_seqlen_k=64,
+    )
+    ranks = np.arange(64) // 16
+    txt = m.visualize(max_cells=8, rank_of_row=ranks)
+    assert "r0" in txt and "r3" in txt
