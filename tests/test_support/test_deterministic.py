@@ -1,0 +1,47 @@
+"""Deterministic-mode guarantee (ref MAGI_ATTENTION_DETERMINISTIC_MODE,
+env/general.py + deterministic.h ordered atomics).
+
+On TPU the FFA kernels have a fixed run ordering (no atomics exist), so
+determinism is structural rather than a special mode — this test pins the
+guarantee: identical inputs give bitwise-identical out/lse/grads across
+repeated jit executions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from magiattention_tpu.api import calc_attn, dispatch, magi_attn_flex_key
+
+S = 256
+
+
+def test_bitwise_deterministic_fwd_bwd():
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("cp",))
+    key = magi_attn_flex_key(
+        [[0, S]], [[0, S]], [1], S, S, mesh=mesh, chunk_size=16
+    )
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((S, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((S, 2, 32)), jnp.float32)
+
+    def loss(q, k, v):
+        qd, kd, vd = (
+            dispatch(q, key),
+            dispatch(k, key, role="kv"),
+            dispatch(v, key, role="kv"),
+        )
+        od, meta = calc_attn(qd, kd, vd, key)
+        return jnp.sum(od * dispatch(w, key)), (od, meta.lse)
+
+    f = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True))
+    (l1, (o1, lse1)), g1 = f(q, k, v)
+    (l2, (o2, lse2)), g2 = f(q, k, v)
+
+    assert float(l1) == float(l2)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(lse1), np.asarray(lse2))
+    for a, b in zip(g1, g2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

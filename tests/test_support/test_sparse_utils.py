@@ -64,3 +64,34 @@ def test_video_mask_pipeline():
     out_ref, _ = ref_attn(q, k, v, dense, compute_dtype=jnp.float32)
     assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
     clear_cache()
+
+
+def test_varlen_block_mask_to_ranges():
+    from magiattention_tpu.utils.sparse_utils import varlen_block_mask_to_ranges
+
+    bm = np.array([[True, True, False], [False, True, True]])
+    qb = np.array([0, 10, 30])  # variable q blocks: 10, 20 tokens
+    kb = np.array([0, 5, 12, 40])  # variable k blocks: 5, 7, 28 tokens
+    q, k, t = varlen_block_mask_to_ranges(bm, qb, kb)
+    got = [(qr.start, qr.end, kr.start, kr.end) for qr, kr in zip(q, k)]
+    assert got == [(0, 10, 0, 12), (10, 30, 5, 40)]
+
+
+def test_topk_indices_to_ranges():
+    from magiattention_tpu.utils.sparse_utils import topk_indices_to_ranges
+
+    idx = np.array([[0, 1, -1], [2, -1, -1]])
+    q, k, t = topk_indices_to_ranges(idx, 8, 16, num_k_blocks=4)
+    got = [(qr.start, qr.end, kr.start, kr.end) for qr, kr in zip(q, k)]
+    # row 0: blocks 0,1 contiguous -> one slice; row 1: block 2
+    assert got == [(0, 8, 0, 32), (8, 16, 32, 48)]
+
+
+def test_dense_oracle_matches_kron():
+    from magiattention_tpu.utils.sparse_utils import block_mask_to_dense_mask
+
+    rng = np.random.default_rng(1)
+    bm = rng.random((4, 6)) < 0.5
+    dense = block_mask_to_dense_mask(bm, 8, 4)
+    assert dense.shape == (32, 24)
+    assert (dense == np.kron(bm, np.ones((8, 4), bool))).all()
