@@ -72,6 +72,11 @@ from .mask_utils import types_to_bands
 NEG_INF = float("-inf")
 NUM_LANES = 128
 NUM_SUBLANES = 8
+# exp2-domain softmax (softcap-free path): folding log2(e) into the q
+# pre-scale turns every exp(x) into a bare exp2, deleting the per-element
+# multiply Mosaic otherwise emits inside exp (flash_attention's idiom)
+LOG2E = float(np.log2(np.e))
+LN2 = float(np.log(2.0))
 # splash's DEFAULT_MASK_VALUE: large but finite so no inf arithmetic reaches
 # Mosaic; exp(MASK_VALUE - anything_sane) underflows to exactly 0.
 MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
@@ -177,6 +182,10 @@ def _fwd_kernel(
     is_first = meta_ref[w, IS_FIRST]
     is_last = meta_ref[w, IS_LAST]
     is_full = meta_ref[w, IS_FULL]
+    # softcap-free path runs the online softmax in the log2 domain (q was
+    # pre-scaled by softmax_scale * log2(e) on the host)
+    use_exp2 = softcap == 0.0
+    exp_fn = jnp.exp2 if use_exp2 else jnp.exp
 
     @pl.when(is_first == 1)
     def _():
@@ -184,7 +193,7 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]  # pre-scaled by softmax_scale on the host
+    q = q_ref[0]  # pre-scaled by softmax_scale (* log2e when softcap-free)
     k = k_ref[0]
     s_raw = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -196,8 +205,8 @@ def _fwd_kernel(
         m_prev = m_scr[...]  # (bq, NUM_LANES)
         m_blk = jnp.max(s, axis=1)[:, None]  # (bq, 1)
         m_new = jnp.maximum(m_prev, m_blk)  # (bq, NUM_LANES)
-        p = jnp.exp(s - _lane_tile(m_new, bk))
-        alpha = jnp.exp(m_prev - m_new)  # (bq, NUM_LANES); ==1 while empty
+        p = exp_fn(s - _lane_tile(m_new, bk))
+        alpha = exp_fn(m_prev - m_new)  # (bq, NUM_LANES); ==1 while empty
 
         l_new = l_scr[...] * alpha + jnp.sum(p, axis=1)[:, None]
         pv = jax.lax.dot_general(
@@ -240,13 +249,23 @@ def _fwd_kernel(
         o = acc_scr[:] / _lane_tile(l_safe, acc_scr.shape[-1])
         o = jnp.where(_lane_tile(empty, o.shape[-1]), 0.0, o)
         out_ref[0] = o.astype(out_ref.dtype)
-        lse_ref[...] = jnp.where(
-            empty, MASK_VALUE, m + jnp.log(l_safe)
-        ).astype(jnp.float32)
+        if use_exp2:
+            # convert back to the natural-log contract
+            lse_nat = (m + jnp.log2(l_safe)) * LN2
+            m_nat = m * LN2
+        else:
+            lse_nat = m + jnp.log(l_safe)
+            m_nat = m
+        lse_ref[...] = jnp.where(empty, MASK_VALUE, lse_nat).astype(
+            jnp.float32
+        )
         if ml_ref is not None:
             # per-row running max of scaled/softcapped logits (lanes equal);
-            # host reduces rows -> per-head. Empty rows stay MASK_VALUE.
-            ml_ref[...] = m.astype(jnp.float32)
+            # host reduces rows -> per-head. Empty rows forced to MASK_VALUE
+            # (m * ln2 would otherwise shift the sentinel).
+            ml_ref[...] = jnp.where(empty, MASK_VALUE, m_nat).astype(
+                jnp.float32
+            )
 
 
 def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
@@ -262,8 +281,11 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
     W = params.num_work
     emit_ml = params.emit_max_logits
 
-    # fold softmax_scale into q (saves a (bq,bk) VPU multiply per grid step)
-    q_t = (q_t.astype(jnp.float32) * params.softmax_scale).astype(q_t.dtype)
+    # fold softmax_scale into q (saves a (bq,bk) VPU multiply per grid
+    # step); the softcap-free path also folds log2(e) to run the softmax in
+    # the exp2 domain
+    q_scale = params.softmax_scale * (LOG2E if params.softcap == 0.0 else 1.0)
+    q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
 
     lse_spec = pl.BlockSpec(
         (None, bq, NUM_LANES), lambda h, w, qt, kt, mt: (h, qt[w], 0),
@@ -368,12 +390,14 @@ def _bwd_dq_kernel(
     is_first = meta_ref[w, IS_FIRST]
     is_last = meta_ref[w, IS_LAST]
     is_full = meta_ref[w, IS_FULL]
+    use_exp2 = softcap == 0.0
+    exp_fn = jnp.exp2 if use_exp2 else jnp.exp
 
     @pl.when(is_first == 1)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0]  # pre-scaled by softmax_scale on the host
+    q = q_ref[0]  # pre-scaled by softmax_scale (* log2e when softcap-free)
     k = k_ref[0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -386,7 +410,8 @@ def _bwd_dq_kernel(
         dcap = None
 
     # lse/delta live q-in-lanes: ref block (1, bq); column views via
-    # expand_dims (splash dq idiom)
+    # expand_dims (splash dq idiom). lse arrives in natural log; the exp2
+    # path converts the (bq,1) column, never the (bq,bk) tile.
     lse = jnp.expand_dims(lse_ref[0], -1)  # (bq, 1)
     delta = jnp.expand_dims(delta_ref[0], -1)  # (bq, 1)
     dp = jax.lax.dot_general(
@@ -398,11 +423,13 @@ def _bwd_dq_kernel(
         if masked:
             neg = lse <= EMPTY_THRESH  # uncovered rows (host clamps -inf)
             lse_safe = jnp.where(neg, 0.0, lse)
-            p = jnp.exp(sm - lse_safe)  # exp(MASK_VALUE - O(1)) == 0
+            if use_exp2:
+                lse_safe = lse_safe * LOG2E
+            p = exp_fn(sm - lse_safe)  # exp(MASK_VALUE - O(1)) == 0
             p = jnp.where(neg, 0.0, p)
         else:
             # a full tile's rows are covered by definition -> lse finite
-            p = jnp.exp(sm - lse)
+            p = exp_fn(sm - (lse * LOG2E if use_exp2 else lse))
         ds = p * (dp - delta)
         if dcap is not None:
             ds = ds * dcap
@@ -447,8 +474,10 @@ def _ffa_bwd_dq_pallas(
     g = params.group
     W = params.num_work
 
-    # pre-scale q; the missing scale factor on ds is applied to dq on return
-    q_t = (q_t.astype(jnp.float32) * params.softmax_scale).astype(q_t.dtype)
+    # pre-scale q (exp2 domain when softcap-free); the missing scale factor
+    # on ds is applied to dq on return
+    q_scale = params.softmax_scale * (LOG2E if params.softcap == 0.0 else 1.0)
+    q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -518,6 +547,8 @@ def _bwd_dkv_kernel(
     is_first = meta_ref[w, IS_FIRST]
     is_last = meta_ref[w, IS_LAST]
     is_full = meta_ref[w, IS_FULL]
+    use_exp2 = softcap == 0.0
+    exp_fn = jnp.exp2 if use_exp2 else jnp.exp
 
     @pl.when(is_first == 1)
     def _():
@@ -525,6 +556,7 @@ def _bwd_dkv_kernel(
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     q = q_ref[0]  # pre-scaled by softmax_scale on the host: dk = ds_t @ q'
+    # (exp2 path: q' also carries log2e; the host divides dk by log2e)
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
@@ -550,10 +582,12 @@ def _bwd_dkv_kernel(
         if masked:
             neg = lse <= EMPTY_THRESH
             lse_safe = jnp.where(neg, 0.0, lse)
-            p_t = jnp.exp(sm_t - lse_safe)
+            if use_exp2:
+                lse_safe = lse_safe * LOG2E
+            p_t = exp_fn(sm_t - lse_safe)
             p_t = jnp.where(neg, 0.0, p_t)
         else:
-            p_t = jnp.exp(sm_t - lse)
+            p_t = exp_fn(sm_t - (lse * LOG2E if use_exp2 else lse))
         dv_scr[:] += jax.lax.dot_general(
             p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -600,8 +634,11 @@ def _ffa_bwd_dkv_pallas(
     g = params.group
     WT = params.num_work_t
 
-    # pre-scale q: dk = ds_t @ q' carries the scale factor exactly
-    q_t = (q_t.astype(jnp.float32) * params.softmax_scale).astype(q_t.dtype)
+    # pre-scale q: dk = ds_t @ q' carries the scale factor exactly; the
+    # exp2-path log2e factor is divided back out of dk on return
+    use_exp2 = params.softcap == 0.0
+    q_scale = params.softmax_scale * (LOG2E if use_exp2 else 1.0)
+    q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -655,6 +692,8 @@ def _ffa_bwd_dkv_pallas(
     )(work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
       _lanes_layout(_clamp_lse(lse_t), NUM_SUBLANES),
       _lanes_layout(delta_t, NUM_SUBLANES))
+    if use_exp2:
+        dk_t = dk_t * LN2  # divide the folded log2e back out
     return dk_t, dv_t
 
 
