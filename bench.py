@@ -44,6 +44,13 @@ def run_worker() -> int:
     import numpy as np
 
     import jax
+
+    if os.environ.get("MAGI_BENCH_FORCE_CPU") == "1":
+        # the axon sitecustomize force-sets JAX_PLATFORMS=axon, overriding
+        # the env var — only jax.config reliably pins the degraded path to
+        # CPU without probing the (possibly hung) TPU plugin
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from magiattention_tpu.benchmarking.bench import do_bench_scan
@@ -167,7 +174,7 @@ def main() -> int:
         env = dict(os.environ)
         if attempt == ATTEMPTS - 1:
             # degraded path: a CPU/interpret number beats no number
-            env["JAX_PLATFORMS"] = "cpu"
+            env["MAGI_BENCH_FORCE_CPU"] = "1"
         try:
             p = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--worker"],
