@@ -36,6 +36,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # rematerialize each layer in backward (jax.checkpoint) — trades FLOPs
+    # for activation memory, the standard long-context training setting
+    remat: bool = False
 
     @property
     def jdtype(self):
@@ -147,7 +150,7 @@ def forward(
     x = dispatch(x, attn_key)
     pos = get_position_ids(attn_key)
 
-    for lyr in params["layers"]:
+    def layer(x, lyr):
         h = _rms_norm(x, lyr["attn_norm"], cfg.norm_eps)
         q = (h @ lyr["wq"].astype(dt)).reshape(-1, cfg.n_heads, cfg.head_dim)
         k = (h @ lyr["wk"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
@@ -161,7 +164,13 @@ def forward(
         h = _rms_norm(x, lyr["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ lyr["w_gate"].astype(dt))
         up = h @ lyr["w_up"].astype(dt)
-        x = x + (gate * up) @ lyr["w_down"].astype(dt)
+        return x + (gate * up) @ lyr["w_down"].astype(dt)
+
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+
+    for lyr in params["layers"]:
+        x = layer(x, lyr)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)

@@ -50,3 +50,33 @@ def test_train_step_decreases_loss():
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+def test_remat_matches_no_remat():
+    import dataclasses
+
+    tokens = np.arange(S, dtype=np.int32) % CFG.vocab_size
+    _, key, params = make(4)
+    w = jnp.asarray(
+        np.random.default_rng(5).standard_normal((S, CFG.vocab_size)),
+        jnp.float32,
+    )
+    from magiattention_tpu.api import dispatch
+
+    def make_loss(cfg):
+        def loss(params):
+            logits = forward(params, cfg, jnp.asarray(tokens), key)
+            return jnp.sum(logits * dispatch(w, key))
+
+        return loss
+
+    cfg_r = dataclasses.replace(CFG, remat=True)
+    l0, g0 = jax.value_and_grad(make_loss(CFG))(params)
+    l1, g1 = jax.value_and_grad(make_loss(cfg_r))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        ),
+        g0, g1,
+    )
