@@ -122,6 +122,59 @@ def group_cast_rows_pp(
     return jnp.take(buf, pp_recv_sel, axis=0)
 
 
+def group_reduce_rows_pp(
+    y: jax.Array,
+    pp_send_idx: jax.Array,
+    pp_recv_sel: jax.Array,
+    deltas: tuple[int, ...],
+    caps: tuple[int, ...],
+    cp: int,
+    axis_name: str,
+    shard_len: int,
+) -> jax.Array:
+    """GroupReduce (op=sum): exact transpose of :func:`group_cast_rows_pp`
+    (scatter-add through the recv selector, inverse ppermute per distance,
+    scatter-add through the send indices). Used where the runtime calls the
+    reduce explicitly instead of via AD (qo-comm backward)."""
+    sum_caps = sum(caps)
+    buf = jnp.zeros((max(sum_caps, 1), *y.shape[1:]), dtype=y.dtype)
+    buf = buf.at[pp_recv_sel].add(y)
+    parts = []
+    off = 0
+    for delta, c in zip(deltas, caps):
+        inv = [((r + delta) % cp, r) for r in range(cp)]
+        parts.append(
+            jax.lax.ppermute(
+                jax.lax.slice_in_dim(buf, off, off + c, axis=0),
+                axis_name,
+                inv,
+            )
+        )
+        off += c
+    back = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+    out = jnp.zeros((shard_len, *y.shape[1:]), dtype=y.dtype)
+    return out.at[pp_send_idx].add(back)
+
+
+def cast_rows(x, ops, kind, axis_name):
+    """Lowering dispatcher: kind is ("a2a",) or ("pp", deltas, caps, cp)."""
+    if kind[0] == "pp":
+        return group_cast_rows_pp(
+            x, ops[0], ops[1], kind[1], kind[2], kind[3], axis_name
+        )
+    return group_cast_rows(x, ops[0], ops[1], axis_name)
+
+
+def reduce_rows(y, ops, kind, axis_name, shard_len):
+    """Transpose dispatcher of :func:`cast_rows`."""
+    if kind[0] == "pp":
+        return group_reduce_rows_pp(
+            y, ops[0], ops[1], kind[1], kind[2], kind[3], axis_name,
+            shard_len,
+        )
+    return group_reduce_rows(y, ops[0], ops[1], axis_name, shard_len)
+
+
 def group_cast_rows_ragged(
     x: jax.Array,
     send_row_idx: jax.Array,

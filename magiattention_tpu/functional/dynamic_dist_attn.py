@@ -31,7 +31,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..comm.primitives import group_cast_rows, group_reduce_rows
+from ..comm.primitives import cast_rows, reduce_rows
 from ..env import general as env_general
 from ..kernels.ffa import (
     FFAParams,
@@ -73,19 +73,20 @@ def _dyn_attn_shard(q, k, v, static, axis, comm, arrays):
 
 
 def _dyn_fwd_impl(q, k, v, static, axis, comm, arrays):
-    params, shard, kv_shard = static
+    params, shard, kv_shard, kinds = static
+    q_kind, k_kind, r_kind = kinds
     (q_send, q_recv, k_send, k_recv, r_send, r_recv, merge_idx) = comm
-    q_rem = group_cast_rows(q, q_send, q_recv, axis)
+    q_rem = cast_rows(q, (q_send, q_recv), q_kind, axis)
     q_buf = jnp.concatenate([q, q_rem], axis=0)
-    k_rem = group_cast_rows(k, k_send, k_recv, axis)
-    v_rem = group_cast_rows(v, k_send, k_recv, axis)
+    k_rem = cast_rows(k, (k_send, k_recv), k_kind, axis)
+    v_rem = cast_rows(v, (k_send, k_recv), k_kind, axis)
     k_buf = jnp.concatenate([k, k_rem], axis=0)
     v_buf = jnp.concatenate([v, v_rem], axis=0)
     out_buf, lse_buf, ml = ffa_attn_with_plan(
         q_buf, k_buf, v_buf, arrays, params, return_max_logits=True
     )
-    ret_out = group_cast_rows(out_buf, r_send, r_recv, axis)
-    ret_lse = group_cast_rows(lse_buf, r_send, r_recv, axis)
+    ret_out = cast_rows(out_buf, (r_send, r_recv), r_kind, axis)
+    ret_lse = cast_rows(lse_buf, (r_send, r_recv), r_kind, axis)
     out, lse = _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx)
     return out, lse, ml, q_buf, k_buf, v_buf
 
@@ -98,15 +99,16 @@ def _dyn_fwd(q, k, v, static, axis, comm, arrays):
 def _dyn_bwd(static, axis, res, cts):
     do, _, _ = cts  # lse/max_logits are auxiliary
     q, k, v, out, lse, comm, arrays = res
-    params, shard, kv_shard = static
+    params, shard, kv_shard, kinds = static
+    q_kind, k_kind, _ = kinds
     (q_send, q_recv, k_send, k_recv, _, _, _) = comm
 
     # rebuild compute buffers (refetch — cheaper than saving the buffers,
     # matching the reference's bwd-side comm)
-    q_rem = group_cast_rows(q, q_send, q_recv, axis)
+    q_rem = cast_rows(q, (q_send, q_recv), q_kind, axis)
     q_buf = jnp.concatenate([q, q_rem], axis=0)
-    k_rem = group_cast_rows(k, k_send, k_recv, axis)
-    v_rem = group_cast_rows(v, k_send, k_recv, axis)
+    k_rem = cast_rows(k, (k_send, k_recv), k_kind, axis)
+    v_rem = cast_rows(v, (k_send, k_recv), k_kind, axis)
     k_buf = jnp.concatenate([k, k_rem], axis=0)
     v_buf = jnp.concatenate([v, v_rem], axis=0)
 
@@ -115,13 +117,13 @@ def _dyn_bwd(static, axis, res, cts):
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # (shard, hq)
     do_buf = jnp.concatenate(
-        [do, group_cast_rows(do, q_send, q_recv, axis)], axis=0
+        [do, cast_rows(do, (q_send, q_recv), q_kind, axis)], axis=0
     )
     lse_buf = jnp.concatenate(
-        [lse, group_cast_rows(lse, q_send, q_recv, axis)], axis=0
+        [lse, cast_rows(lse, (q_send, q_recv), q_kind, axis)], axis=0
     )
     delta_buf = jnp.concatenate(
-        [delta, group_cast_rows(delta, q_send, q_recv, axis)], axis=0
+        [delta, cast_rows(delta, (q_send, q_recv), q_kind, axis)], axis=0
     )
 
     sqp = params.num_q_tiles * params.block_q
@@ -152,14 +154,14 @@ def _dyn_bwd(static, axis, res, cts):
     dk_buf = dk_t.transpose(1, 0, 2)[: k_buf.shape[0]]
     dv_buf = dv_t.transpose(1, 0, 2)[: v_buf.shape[0]]
 
-    dq = dq_buf[:shard] + group_reduce_rows(
-        dq_buf[shard:], q_send, q_recv, axis, shard
+    dq = dq_buf[:shard] + reduce_rows(
+        dq_buf[shard:], (q_send, q_recv), q_kind, axis, shard
     )
-    dk = dk_buf[:kv_shard] + group_reduce_rows(
-        dk_buf[kv_shard:], k_send, k_recv, axis, kv_shard
+    dk = dk_buf[:kv_shard] + reduce_rows(
+        dk_buf[kv_shard:], (k_send, k_recv), k_kind, axis, kv_shard
     )
-    dv = dv_buf[:kv_shard] + group_reduce_rows(
-        dv_buf[kv_shard:], k_send, k_recv, axis, kv_shard
+    dv = dv_buf[:kv_shard] + reduce_rows(
+        dv_buf[kv_shard:], (k_send, k_recv), k_kind, axis, kv_shard
     )
     return (
         dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
@@ -191,13 +193,24 @@ class DynamicDistAttnRuntime:
             p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk
         )
         self._dims = (nqt, nkt, w, wt)
+        def ops_of(cast):
+            if cast.lowering == "ppermute":
+                cp = cast.send_counts.shape[0]
+                return (
+                    (jnp.asarray(cast.pp_send_idx),
+                     jnp.asarray(cast.pp_recv_sel)),
+                    ("pp", cast.pp_deltas, cast.pp_caps, cp),
+                )
+            return (
+                (jnp.asarray(cast.send_idx), jnp.asarray(cast.recv_sel)),
+                ("a2a",),
+            )
+
+        (q_ops, self._q_kind) = ops_of(p.q_cast)
+        (k_ops, self._k_kind) = ops_of(p.kv_cast)
+        (r_ops, self._r_kind) = ops_of(p.ret)
         self._comm = (
-            jnp.asarray(p.q_cast.send_idx),
-            jnp.asarray(p.q_cast.recv_sel),
-            jnp.asarray(p.kv_cast.send_idx),
-            jnp.asarray(p.kv_cast.recv_sel),
-            jnp.asarray(p.ret.send_idx),
-            jnp.asarray(p.ret.recv_sel),
+            q_ops[0], q_ops[1], k_ops[0], k_ops[1], r_ops[0], r_ops[1],
             jnp.asarray(p.merge_idx),
         )
 
@@ -241,7 +254,10 @@ class DynamicDistAttnRuntime:
             softmax_scale=scale, softcap=self.softcap, group=group,
             interpret=_should_interpret(),
         )
-        static = (params, p.shard_len, p.kv_shard_len)
+        static = (
+            params, p.shard_len, p.kv_shard_len,
+            (self._q_kind, self._k_kind, self._r_kind),
+        )
 
         def f(q, k, v, comm, arrays):
             comm_local = tuple(c[0] for c in comm)
@@ -291,26 +307,28 @@ class DynamicDistAttnRuntime:
             for f in ("q_ranges", "k_ranges", "d_lo", "d_hi")
         )
 
+        q_kind, k_kind, r_kind = self._q_kind, self._k_kind, self._r_kind
+
         def f(q, k, v, comm, slices):
             (q_send, q_recv, k_send, k_recv, r_send, r_recv, merge_idx) = (
                 tuple(c[0] for c in comm)
             )
             q_buf = jnp.concatenate(
-                [q, group_cast_rows(q, q_send, q_recv, axis)], axis=0
+                [q, cast_rows(q, (q_send, q_recv), q_kind, axis)], axis=0
             )
             k_buf = jnp.concatenate(
-                [k, group_cast_rows(k, k_send, k_recv, axis)], axis=0
+                [k, cast_rows(k, (k_send, k_recv), k_kind, axis)], axis=0
             )
             v_buf = jnp.concatenate(
-                [v, group_cast_rows(v, k_send, k_recv, axis)], axis=0
+                [v, cast_rows(v, (k_send, k_recv), k_kind, axis)], axis=0
             )
             qr, kr, lo, hi = (a[0] for a in slices)
             out_buf, lse_buf = dense_fn(
                 q_buf, k_buf, v_buf, qr, kr, None,
                 softmax_scale=scale, softcap=softcap, d_lo=lo, d_hi=hi,
             )
-            ret_out = group_cast_rows(out_buf, r_send, r_recv, axis)
-            ret_lse = group_cast_rows(lse_buf, r_send, r_recv, axis)
+            ret_out = cast_rows(out_buf, (r_send, r_recv), r_kind, axis)
+            ret_lse = cast_rows(lse_buf, (r_send, r_recv), r_kind, axis)
             out, lse = _merge_rows(
                 out_buf, lse_buf, ret_out, ret_lse, merge_idx
             )
