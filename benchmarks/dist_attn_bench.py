@@ -115,7 +115,7 @@ def main() -> int:
             q, k, v, qr, qr, tm, mesh1d)[0])
 
     if "allgather" in impls:
-        from magiattention_tpu.parallel.ring import allgather_attn
+        from magiattention_tpu.parallel.hybrid import allgather_attn
 
         record("allgather", lambda q, k, v: allgather_attn(
             q, k, v, qr, qr, tm, mesh1d)[0])
