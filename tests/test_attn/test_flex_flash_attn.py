@@ -221,3 +221,54 @@ def test_max_logits_softcap():
             np.asarray(meta.max_logits), np.asarray(ml_ref),
             atol=1e-5, rtol=1e-5,
         )
+
+
+@pytest.mark.parametrize("hq,hk", [(4, 4), (4, 2), (8, 2)])
+def test_gqa_group_ratios(hq, hk):
+    """GQA grouping grid (ref kernel tests sweep head configs)."""
+    qr, kr, tm = MASK_CASES["varlen_causal"]
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((S, hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, hk, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, hk, D)), jnp.float32)
+    out, meta = flex_flash_attn_func(
+        q, k, v, np.array(qr), np.array(kr), np.array(tm), backend="ffa"
+    )
+    out_ref, lse_ref = ref_attn(q, k, v, dense_mask("varlen_causal"))
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=2e-5,
+                 msg=f"gqa {hq}/{hk} out")
+    assert_close(meta.lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=2e-5,
+                 msg=f"gqa {hq}/{hk} lse")
+
+
+def test_asymmetric_dv():
+    """dv != dk (MLA-style value dim) through the kernel + grads."""
+    qr, kr, tm = MASK_CASES["causal"]
+    dv = 32
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, dv)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((S, HQ, dv)), jnp.float32)
+
+    def loss(q, k, v):
+        out, _ = flex_flash_attn_func(
+            q, k, v, np.array(qr), np.array(kr), np.array(tm), backend="ffa"
+        )
+        return jnp.sum(out * w), out
+
+    (l, out), grads = jax.value_and_grad(loss, argnums=(0, 1, 2),
+                                         has_aux=True)(q, k, v)
+    out_ref, _ = ref_attn(q, k, v, dense_mask("causal"))
+
+    def ref_loss(q, k, v):
+        o, _ = ref_attn(q, k, v, dense_mask("causal"),
+                        compute_dtype=jnp.float32)
+        return jnp.sum(o * w)
+
+    rgrads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=2e-5,
+                 msg="dv!=dk out")
+    for name, a, b in zip("dq dk dv".split(), grads, rgrads):
+        assert_close(a, b, atol=1e-3, rtol=1e-3, norm_rtol=3e-4,
+                     msg=f"dv!=dk {name}")
