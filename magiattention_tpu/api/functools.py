@@ -79,14 +79,22 @@ def infer_attn_mask_from_sliding_window(
                 "only causal sliding windows are compiled for now"
             )
         lw = left if left >= 0 else e - s
-        if sink_size > 0:
-            emit(s, e, s, s + sink_size, AttnMaskType.FULL)
-        # rows see [i-lw, i]: head part is plain causal, tail is bicausal
-        split = min(s + lw + 1, e)
-        emit(s, split, s, split, AttnMaskType.CAUSAL)
+        # Disjoint decomposition (overlapping slices would double-count in
+        # the kernel's softmax): sink-region rows attend plain-causally;
+        # later rows attend the whole sink strip plus their window clipped
+        # to start after the sink.
+        snk = min(sink_size, e - s)
+        if snk > 0:
+            emit(s, s + snk, s, s + snk, AttnMaskType.CAUSAL)
+            emit(s + snk, e, s, s + snk, AttnMaskType.FULL)
+        w0 = s + snk  # first non-sink column / row
+        # rows r >= w0 see cols [max(r-lw, w0), r] beyond the sink: head
+        # part is plain causal, tail is a bicausal band
+        hsplit = min(w0 + lw + 1, e)
+        emit(w0, hsplit, w0, hsplit, AttnMaskType.CAUSAL)
         # BICAUSAL band: lo = ks - qs = -lw  => ks = qs - lw
         #                hi = ke - qe = 0    => ke = qe
-        emit(split, e, split - lw, e, AttnMaskType.BICAUSAL)
+        emit(hsplit, e, hsplit - lw, e, AttnMaskType.BICAUSAL)
     return out_q, out_k, out_t
 
 
