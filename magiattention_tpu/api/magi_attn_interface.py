@@ -26,7 +26,47 @@ from ..dist_attn_runtime_mgr import (
     _mesh_signature,
 )
 from ..env import snapshot_env
+from ..env import general as env_general
 from .functools import infer_attn_mask_from_cu_seqlens
+
+
+def _check_no_overlapping_slices(q_ranges, k_ranges, mask_ints) -> None:
+    """Sanity invariant: slice coverage must be disjoint — overlapping
+    (q, k) coverage is double-counted by the kernel's online softmax (the
+    bug class fixed in the sliding-window+sink compiler). Pairwise band
+    geometry, gated behind MAGI_ATTENTION_SANITY_CHECK."""
+    import numpy as np
+
+    from ..kernels.mask_utils import types_to_bands
+
+    n = len(q_ranges)
+    if n > 4096:  # keep the check O(n^2)-affordable
+        return
+    qr = np.array([[r.start, r.end] for r in q_ranges], np.int64)
+    kr = np.array([[r.start, r.end] for r in k_ranges], np.int64)
+    lo, hi = types_to_bands(
+        qr.astype(np.int32), kr.astype(np.int32),
+        np.asarray(mask_ints, np.int32),
+    )
+    lo = lo.astype(np.int64)
+    hi = hi.astype(np.int64)
+    for i in range(n):
+        for j in range(i + 1, n):
+            q0 = max(qr[i, 0], qr[j, 0])
+            q1 = min(qr[i, 1], qr[j, 1])
+            k0 = max(kr[i, 0], kr[j, 0])
+            k1 = min(kr[i, 1], kr[j, 1])
+            if q0 >= q1 or k0 >= k1:
+                continue
+            d_lo = max(lo[i], lo[j], k0 - (q1 - 1))
+            d_hi = min(hi[i], hi[j], (k1 - 1) - q0)
+            if d_lo <= d_hi:
+                raise ValueError(
+                    f"slices {i} and {j} overlap on q[{q0},{q1}) x "
+                    f"k[{k0},{k1}) (band [{d_lo},{d_hi}]): overlapping "
+                    "coverage double-counts in the softmax — make the "
+                    "slice set disjoint"
+                )
 
 _runtime_dict = DistAttnRuntimeDict()
 _most_recent_key: DistAttnRuntimeKey | None = None
@@ -80,6 +120,8 @@ def magi_attn_flex_key(
     mask_ints = tuple(
         AttnMaskType.normalize(t).to_int_type() for t in attn_mask_type
     )
+    if env_general.is_sanity_check_enable():
+        _check_no_overlapping_slices(q_ranges, k_ranges, mask_ints)
     if isinstance(cp_axis, (tuple, list)):
         # 2D (dcn, ici) cp mesh — hierarchical comm capable
         cp_axis = tuple(cp_axis)

@@ -89,3 +89,23 @@ def test_detects_area_mismatch():
         _sanity_check_plan(
             comm_meta, calc_meta, kv_ranges_of(comm_meta), bucket, meta_q
         )
+
+
+def test_detects_overlapping_slice_coverage(monkeypatch):
+    """Overlapping (q,k) coverage double-counts in the softmax — the key
+    constructor must reject it under sanity mode (the sliding-window+sink
+    compiler bug class)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.api import magi_attn_flex_key
+
+    monkeypatch.setenv("MAGI_ATTENTION_SANITY_CHECK", "1")
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("cp",))
+    with pytest.raises(ValueError, match="overlap"):
+        magi_attn_flex_key(
+            [[0, 128], [64, 128]],  # second slice's coverage overlaps
+            [[0, 128], [0, 96]],
+            [0, 0],  # FULL, FULL
+            128, 128, mesh=mesh, cp_axis="cp", chunk_size=16,
+        )
