@@ -64,7 +64,7 @@ def run_worker() -> int:
         S, HQ, HK, D = 512, 4, 2, 64
 
     block_q = int(os.environ.get("MAGI_BENCH_BLOCK_Q", "512"))
-    block_k = int(os.environ.get("MAGI_BENCH_BLOCK_K", "1024"))
+    block_k = int(os.environ.get("MAGI_BENCH_BLOCK_K", "512"))
 
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=dtype)
