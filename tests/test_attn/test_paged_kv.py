@@ -85,3 +85,72 @@ def test_paged_prefill_chunk_matches_dense():
     ro, rlse = ref_attn(q, k_nat, v_nat, mask, compute_dtype=jnp.float32)
     assert_close(out, ro, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
     assert_close(lse, rlse, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
+
+
+def test_paged_decode_logits_match_dense_model():
+    """Greedy decode via the paged cache must produce the same per-step
+    logits as the dense-causal model on the growing context."""
+    import jax as _jax
+
+    from magiattention_tpu.models import LlamaConfig, init_params
+    from magiattention_tpu.models.llama import _rms_norm, _rope, forward_dense
+
+    cfg = LlamaConfig(
+        vocab_size=64, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=16, ffn_hidden=128, dtype="float32",
+    )
+    params = init_params(cfg, jax.random.key(1))
+    dt = cfg.jdtype
+    PS2, PROMPT, STEPS = 8, 19, 4
+    max_len = PROMPT + STEPS
+    pages = -(-max_len // PS2)
+
+    caches = [
+        PagedKVCache.create(
+            num_pages=2 * pages, page_size=PS2, n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim, max_seqs=1, max_pages_per_seq=pages,
+            dtype=dt,
+        )
+        for _ in range(cfg.n_layers)
+    ]
+    rng = np.random.default_rng(3)
+    for i in range(cfg.n_layers):
+        caches[i] = assign_pages(
+            caches[i], 0, rng.permutation(2 * pages)[:pages]
+        )
+
+    def forward_chunk(tokens, q_start):
+        pos = q_start + jnp.arange(tokens.shape[0], dtype=jnp.int32)
+        x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
+        for li, lyr in enumerate(params["layers"]):
+            h = _rms_norm(x, lyr["attn_norm"], cfg.norm_eps)
+            k = (h @ lyr["wk"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            v = (h @ lyr["wv"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            caches[li] = append_kv(caches[li], 0, _rope(k, pos, cfg.rope_theta), v)
+            q = (h @ lyr["wq"].astype(dt)).reshape(-1, cfg.n_heads, cfg.head_dim)
+            q = _rope(q, pos, cfg.rope_theta)
+            out, _ = paged_attn(q, caches[li], 0, q_start=q_start,
+                                max_pages=pages)
+            x = x + out.reshape(-1, cfg.n_heads * cfg.head_dim) @ lyr["wo"].astype(dt)
+            h = _rms_norm(x, lyr["mlp_norm"], cfg.norm_eps)
+            gate = _jax.nn.silu(h @ lyr["w_gate"].astype(dt))
+            x = x + (gate * (h @ lyr["w_up"].astype(dt))) @ lyr["w_down"].astype(dt)
+        x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+        return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+    tokens = rng.integers(0, cfg.vocab_size, PROMPT).astype(np.int32)
+    ctx = list(tokens)
+    logits = forward_chunk(jnp.asarray(tokens), 0)
+    for step in range(STEPS):
+        # dense oracle over the current full context
+        s = len(ctx)
+        mask = np.tril(np.ones((s, s), dtype=bool))
+        ref = forward_dense(params, cfg, jnp.asarray(np.array(ctx)), mask)
+        np.testing.assert_allclose(
+            np.asarray(logits[-1]), np.asarray(ref[-1]),
+            rtol=2e-4, atol=2e-4,
+        )
+        nxt = int(jnp.argmax(logits[-1]))
+        ctx.append(nxt)
+        if step < STEPS - 1:
+            logits = forward_chunk(jnp.asarray([nxt]), len(ctx) - 1)
