@@ -187,6 +187,12 @@ class DistAttnRuntimeMgr:
         v: jax.Array,
         return_max_logits: bool = False,
     ):
+        if env_general.precision() == "bf16":
+            # precision override (ref dist_attn.py:3760-3786) — applied at
+            # the manager chokepoint so every entry path honors it
+            import jax.numpy as jnp
+
+            q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
         return self.runtime.calc_attn(
             q, k, v, return_max_logits=return_max_logits
         )

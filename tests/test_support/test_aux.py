@@ -67,3 +67,31 @@ def test_instrument_scope():
 def test_vmem_budget_reasonable():
     b = ffa_vmem_budget(256, 512, 128)
     assert 0 < b < 16 * 1024 * 1024  # fits one v5e core's VMEM
+
+
+def test_precision_flag_casts_to_bf16(monkeypatch):
+    """MAGI_ATTENTION_PRECISION=bf16 must cast q/k/v before the kernel
+    (ref precision override, functional/dist_attn.py:3760)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.api import calc_attn, dispatch, magi_attn_flex_key
+
+    monkeypatch.setenv("MAGI_ATTENTION_PRECISION", "bf16")
+    S = 128
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("cp",))
+    key = magi_attn_flex_key(
+        [[0, S]], [[0, S]], [1], S, S, mesh=mesh, chunk_size=16
+    )
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((S, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    out, _ = calc_attn(
+        dispatch(q, key), dispatch(k, key, role="kv"),
+        dispatch(v, key, role="kv"), key,
+    )
+    # the kernel computed in bf16: out dtype follows the cast inputs
+    assert out.dtype == jnp.bfloat16
