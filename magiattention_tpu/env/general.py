@@ -88,6 +88,9 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     "MAGI_ATTENTION_HIERARCHICAL_COMM",
     "MAGI_ATTENTION_FFA_BLOCK_Q",
     "MAGI_ATTENTION_FFA_BLOCK_K",
+    # wire-tier selection changes the traced collective program
+    "MAGI_ATTENTION_RAGGED_GRPCOLL",
+    "MAGI_ATTENTION_SPLIT_ALIGNMENT",
 )
 
 
