@@ -75,26 +75,44 @@ def run_worker() -> int:
     kr = np.array([[0, S]], dtype=np.int32)
     tm = np.array([1], dtype=np.int32)  # causal
 
-    def loss(q, k, v):
-        o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=block_q, block_k=block_k)
-        return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+    def make_body(bq, bk):
+        def loss(q, k, v):
+            o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
 
-    grad = jax.grad(loss, argnums=(0, 1, 2))
+        grad = jax.grad(loss, argnums=(0, 1, 2))
 
-    def body(q):
-        g = grad(q, k, v)
-        return (q + 1e-3 * g[0].astype(dtype)).astype(dtype)
+        def body(q):
+            g = grad(q, k, v)
+            return (q + 1e-3 * g[0].astype(dtype)).astype(dtype)
+
+        return body
 
     timing_mode = "scan"
+    t_start = time.perf_counter()
     try:
         if backend == "cpu":
             raise _FallbackTiming("interpret mode: skip scan timing")
-        dt_ms = do_bench_scan(body, q, length=6, reps=2)
+        dt_ms = do_bench_scan(make_body(block_q, block_k), q, length=6, reps=2)
+        # mini-sweep: try one alternative tiling if the timeout budget
+        # allows (worker hard-cap is 420s; first compile dominates)
+        for bq2, bk2 in ((256, 512), (512, 1024)):
+            if time.perf_counter() - t_start > 180:
+                break
+            try:
+                alt_ms = do_bench_scan(
+                    make_body(bq2, bk2), q, length=6, reps=2
+                )
+                if alt_ms < dt_ms:
+                    dt_ms = alt_ms
+                    block_q, block_k = bq2, bk2
+            except Exception:
+                break
     except Exception as e:
         # fallback: chained dispatches (serial data dependence). Record why so
         # a real compile failure in the scan path is visible in the output.
         timing_mode = f"chained ({type(e).__name__})"
-        step = jax.jit(body)
+        step = jax.jit(make_body(block_q, block_k))
         qq = step(q)
         qq.block_until_ready()
         iters = 8 if backend != "cpu" else 1
