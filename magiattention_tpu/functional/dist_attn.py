@@ -438,7 +438,7 @@ class DistAttnRuntime:
                         softmax_scale=scale, softcap=softcap,
                         d_lo=lo, d_hi=hi,
                     )
-                    return out, lse, jax.lax.pmax(ml, axis)
+                    return out, lse, jax.lax.pmax(jax.lax.stop_gradient(ml), axis)
                 return out, lse
 
             fn = shard_map(
@@ -470,7 +470,7 @@ class DistAttnRuntime:
                         q, k_all, v_all, local_arrays, params,
                         return_max_logits=True,
                     )
-                    return out, lse, jax.lax.pmax(ml, axis)
+                    return out, lse, jax.lax.pmax(jax.lax.stop_gradient(ml), axis)
                 return ffa_attn_with_plan(q, k_all, v_all, local_arrays, params)
 
             fn = shard_map(
@@ -508,7 +508,7 @@ class DistAttnRuntime:
                 q, tuple(ks), tuple(vs), arrays_list, all_params
             )
             if return_max_logits:
-                return out, lse, jax.lax.pmax(ml, axis)
+                return out, lse, jax.lax.pmax(jax.lax.stop_gradient(ml), axis)
             return out, lse
 
         fn = shard_map(

@@ -269,7 +269,7 @@ class DynamicDistAttnRuntime:
                 q, k, v, static, axis, comm_local, arrays_local
             )
             if return_max_logits:
-                return out, lse, jax.lax.pmax(ml, axis)
+                return out, lse, jax.lax.pmax(jax.lax.stop_gradient(ml), axis)
             return out, lse
 
         out_specs = (spec, spec, P()) if return_max_logits else (spec, spec)
@@ -340,7 +340,7 @@ class DynamicDistAttnRuntime:
                     q_buf, k_buf, qr, kr, None,
                     softmax_scale=scale, softcap=softcap, d_lo=lo, d_hi=hi,
                 )
-                return out, lse, jax.lax.pmax(ml, axis)
+                return out, lse, jax.lax.pmax(jax.lax.stop_gradient(ml), axis)
             return out, lse
 
         out_specs = (spec, spec, P()) if return_max_logits else (spec, spec)
