@@ -124,7 +124,6 @@ class DistAttnRuntimeMgr:
             self.bucket, self.dispatch_meta_q, key.config,
             dispatch_meta_kv=self.dispatch_meta_kv,
         )
-        self._log_comm_plan()
         overlap_cfg = key.config.overlap_config
         self.runtime = DistAttnRuntime(
             comm_meta=self.comm_meta,
@@ -136,6 +135,7 @@ class DistAttnRuntimeMgr:
             # forced single merged kernel when disabled
             use_overlap=None if overlap_cfg.enable else False,
         )
+        self._log_comm_plan()
 
     def _log_comm_plan(self) -> None:
         """INFO-dump the comm plan at init (ref dist_attn_runtime_mgr.py:
@@ -147,14 +147,22 @@ class DistAttnRuntimeMgr:
         if not logger.isEnabledFor(logging.INFO):
             return
         cm = self.comm_meta
+        # the runtime may override the solver's portable lowering with the
+        # backend-dependent ragged/hier tier — report what actually runs
+        kinds = getattr(self.runtime, "_cast_kinds", None)
         for st, s in enumerate(cm.kv_stages):
+            executed = kinds[st][0] if kinds and st < len(kinds) else s.lowering
+            wire = (
+                s.payload_rows() if executed == "ragged" else s.wire_rows()
+            )
             logger.info(
-                "comm plan stage %d/%d: lowering=%s payload_rows=%d "
-                "wire_rows=%d ratio=%.3f (a2a would be %d) a_cap=%d r_max=%d "
-                "per-rank send rows=%s recv rows=%s",
-                st, len(cm.kv_stages), s.lowering, s.payload_rows(),
-                s.wire_rows(), s.wire_ratio(), s.wire_rows("a2a"), s.a_cap,
-                s.r_max, s.send_counts.sum(axis=1).tolist(),
+                "comm plan stage %d/%d: executed=%s planned=%s "
+                "payload_rows=%d wire_rows=%d ratio=%.3f (a2a would be %d) "
+                "a_cap=%d r_max=%d per-rank send rows=%s recv rows=%s",
+                st, len(cm.kv_stages), executed, s.lowering,
+                s.payload_rows(), wire,
+                wire / max(s.payload_rows(), 1), s.wire_rows("a2a"),
+                s.a_cap, s.r_max, s.send_counts.sum(axis=1).tolist(),
                 s.recv_len.tolist(),
             )
 

@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..comm.primitives import group_cast_rows, group_cast_rows_pp
+from ..comm.primitives import cast_rows
 from ..env import comm as env_comm
 from ..env import general as env_general
 from ..kernels.ffa import (
@@ -294,7 +294,9 @@ class DistAttnRuntime:
                     self._cast_ops.append(
                         (jnp.asarray(s.pp_send_idx), jnp.asarray(s.pp_recv_sel))
                     )
-                    self._cast_kinds.append(("pp", s.pp_deltas, s.pp_caps))
+                    self._cast_kinds.append(
+                        ("pp", s.pp_deltas, s.pp_caps, self.cp_size)
+                    )
                 else:
                     self._cast_ops.append(
                         (jnp.asarray(s.send_idx), jnp.asarray(s.recv_sel))
@@ -319,20 +321,10 @@ class DistAttnRuntime:
                 x, ops[0][0], ops[1][0], ops[2][0], ops[3][0],
                 dcn_axis, ici_axis,
             )
-        kind = self._cast_kinds[stage]
-        if kind[0] == "ragged":
-            from ..comm.primitives import group_cast_rows_ragged
-
-            return group_cast_rows_ragged(
-                x, ops[0][0], ops[1][0], ops[2][0], ops[3][0], ops[4][0],
-                kind[1], self.cp_axis,
-            )
-        if kind[0] == "pp":
-            return group_cast_rows_pp(
-                x, ops[0][0], ops[1][0], kind[1], kind[2],
-                self.cp_size, self.cp_axis,
-            )
-        return group_cast_rows(x, ops[0][0], ops[1][0], self.cp_axis)
+        return cast_rows(
+            x, tuple(o[0] for o in ops), self._cast_kinds[stage],
+            self.cp_axis,
+        )
 
     def _cast_kv(self, k, v, ops, stage: int = 0):
         """Fused K|V GroupCast: one collective for both tensors (the
@@ -351,13 +343,18 @@ class DistAttnRuntime:
 
     # ------------------------------------------------------------------
 
-    def _ffa_params(self, dims, scale, group) -> FFAParams:
+    def _ffa_params(
+        self, dims, scale, group, emit_max_logits: bool = False
+    ) -> FFAParams:
         nqt, nkt, w, wt = dims
         return FFAParams(
             num_work=w, num_work_t=wt, num_q_tiles=nqt, num_k_tiles=nkt,
             block_q=self._bq, block_k=self._bk,
             softmax_scale=scale, softcap=self.softcap, group=group,
             interpret=_should_interpret(),
+            # the max-logits output costs an (hq, sqp, 128) fp32 HBM write
+            # per kernel call — emitted only when the caller asks
+            emit_max_logits=emit_max_logits,
         )
 
     def calc_attn(
@@ -454,7 +451,9 @@ class DistAttnRuntime:
             return fn(q, k, v, self._cast_ops, self._merged_slices)
 
         if not self.use_overlap:
-            params = self._ffa_params(self._merged_dims, scale, group)
+            params = self._ffa_params(
+                self._merged_dims, scale, group, return_max_logits
+            )
 
             def f(q, k, v, cast_ops, arrays):
                 kv_parts_k, kv_parts_v = [k], [v]
@@ -486,9 +485,12 @@ class DistAttnRuntime:
             return fn(q, k, v, self._cast_ops, self._merged_arrays)
 
         # multi-stage overlap path
-        host_params = self._ffa_params(self._host_dims, scale, group)
+        host_params = self._ffa_params(
+            self._host_dims, scale, group, return_max_logits
+        )
         stage_params = [
-            self._ffa_params(d, scale, group) for d in self._stage_dims
+            self._ffa_params(d, scale, group, return_max_logits)
+            for d in self._stage_dims
         ]
 
         all_params = (host_params, *stage_params)

@@ -75,18 +75,19 @@ def _dyn_attn_shard(q, k, v, static, axis, comm, arrays):
 def _dyn_fwd_impl(q, k, v, static, axis, comm, arrays):
     params, shard, kv_shard, kinds = static
     q_kind, k_kind, r_kind = kinds
-    (q_send, q_recv, k_send, k_recv, r_send, r_recv, merge_idx) = comm
-    q_rem = cast_rows(q, (q_send, q_recv), q_kind, axis)
+    (q_ops, k_ops, r_ops, (merge_idx,)) = comm
+    q_rem = cast_rows(q, q_ops, q_kind, axis)
     q_buf = jnp.concatenate([q, q_rem], axis=0)
-    k_rem = cast_rows(k, (k_send, k_recv), k_kind, axis)
-    v_rem = cast_rows(v, (k_send, k_recv), k_kind, axis)
+    k_rem = cast_rows(k, k_ops, k_kind, axis)
+    v_rem = cast_rows(v, k_ops, k_kind, axis)
     k_buf = jnp.concatenate([k, k_rem], axis=0)
     v_buf = jnp.concatenate([v, v_rem], axis=0)
     out_buf, lse_buf, ml = ffa_attn_with_plan(
-        q_buf, k_buf, v_buf, arrays, params, return_max_logits=True
+        q_buf, k_buf, v_buf, arrays, params,
+        return_max_logits=True,  # ml is constant -inf unless params emit it
     )
-    ret_out = cast_rows(out_buf, (r_send, r_recv), r_kind, axis)
-    ret_lse = cast_rows(lse_buf, (r_send, r_recv), r_kind, axis)
+    ret_out = cast_rows(out_buf, r_ops, r_kind, axis)
+    ret_lse = cast_rows(lse_buf, r_ops, r_kind, axis)
     out, lse = _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx)
     return out, lse, ml, q_buf, k_buf, v_buf
 
@@ -101,14 +102,14 @@ def _dyn_bwd(static, axis, res, cts):
     q, k, v, out, lse, comm, arrays = res
     params, shard, kv_shard, kinds = static
     q_kind, k_kind, _ = kinds
-    (q_send, q_recv, k_send, k_recv, _, _, _) = comm
+    (q_ops, k_ops, _, _) = comm
 
     # rebuild compute buffers (refetch — cheaper than saving the buffers,
     # matching the reference's bwd-side comm)
-    q_rem = cast_rows(q, (q_send, q_recv), q_kind, axis)
+    q_rem = cast_rows(q, q_ops, q_kind, axis)
     q_buf = jnp.concatenate([q, q_rem], axis=0)
-    k_rem = cast_rows(k, (k_send, k_recv), k_kind, axis)
-    v_rem = cast_rows(v, (k_send, k_recv), k_kind, axis)
+    k_rem = cast_rows(k, k_ops, k_kind, axis)
+    v_rem = cast_rows(v, k_ops, k_kind, axis)
     k_buf = jnp.concatenate([k, k_rem], axis=0)
     v_buf = jnp.concatenate([v, v_rem], axis=0)
 
@@ -117,13 +118,13 @@ def _dyn_bwd(static, axis, res, cts):
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # (shard, hq)
     do_buf = jnp.concatenate(
-        [do, cast_rows(do, (q_send, q_recv), q_kind, axis)], axis=0
+        [do, cast_rows(do, q_ops, q_kind, axis)], axis=0
     )
     lse_buf = jnp.concatenate(
-        [lse, cast_rows(lse, (q_send, q_recv), q_kind, axis)], axis=0
+        [lse, cast_rows(lse, q_ops, q_kind, axis)], axis=0
     )
     delta_buf = jnp.concatenate(
-        [delta, cast_rows(delta, (q_send, q_recv), q_kind, axis)], axis=0
+        [delta, cast_rows(delta, q_ops, q_kind, axis)], axis=0
     )
 
     sqp = params.num_q_tiles * params.block_q
@@ -155,13 +156,13 @@ def _dyn_bwd(static, axis, res, cts):
     dv_buf = dv_t.transpose(1, 0, 2)[: v_buf.shape[0]]
 
     dq = dq_buf[:shard] + reduce_rows(
-        dq_buf[shard:], (q_send, q_recv), q_kind, axis, shard
+        dq_buf[shard:], q_ops, q_kind, axis, shard
     )
     dk = dk_buf[:kv_shard] + reduce_rows(
-        dk_buf[kv_shard:], (k_send, k_recv), k_kind, axis, kv_shard
+        dk_buf[kv_shard:], k_ops, k_kind, axis, kv_shard
     )
     dv = dv_buf[:kv_shard] + reduce_rows(
-        dv_buf[kv_shard:], (k_send, k_recv), k_kind, axis, kv_shard
+        dv_buf[kv_shard:], k_ops, k_kind, axis, kv_shard
     )
     return (
         dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
@@ -193,7 +194,15 @@ class DynamicDistAttnRuntime:
             p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk
         )
         self._dims = (nqt, nkt, w, wt)
+        from ..env import comm as env_comm
+
+        use_ragged = env_comm.is_ragged_grpcoll_enable()
+
         def ops_of(cast):
+            if use_ragged:
+                from .dist_attn import _ragged_arrays
+
+                return (_ragged_arrays(cast), ("ragged", cast.r_max))
             if cast.lowering == "ppermute":
                 cp = cast.send_counts.shape[0]
                 return (
@@ -209,10 +218,7 @@ class DynamicDistAttnRuntime:
         (q_ops, self._q_kind) = ops_of(p.q_cast)
         (k_ops, self._k_kind) = ops_of(p.kv_cast)
         (r_ops, self._r_kind) = ops_of(p.ret)
-        self._comm = (
-            q_ops[0], q_ops[1], k_ops[0], k_ops[1], r_ops[0], r_ops[1],
-            jnp.asarray(p.merge_idx),
-        )
+        self._comm = (q_ops, k_ops, r_ops, (jnp.asarray(p.merge_idx),))
 
     @property
     def backend(self) -> str:
@@ -253,6 +259,7 @@ class DynamicDistAttnRuntime:
             block_q=self._bq, block_k=self._bk,
             softmax_scale=scale, softcap=self.softcap, group=group,
             interpret=_should_interpret(),
+            emit_max_logits=return_max_logits,
         )
         static = (
             params, p.shard_len, p.kv_shard_len,
@@ -260,7 +267,9 @@ class DynamicDistAttnRuntime:
         )
 
         def f(q, k, v, comm, arrays):
-            comm_local = tuple(c[0] for c in comm)
+            comm_local = tuple(
+                tuple(a[0] for a in grp) for grp in comm
+            )
             arrays_local = tuple(a[0] for a in arrays)
             # each rank's compute covers its assigned rectangles, so the
             # cp MAX of the kernel's per-head max is the global per-head
@@ -277,7 +286,9 @@ class DynamicDistAttnRuntime:
             f,
             mesh=self.mesh,
             in_specs=(spec, spec, spec,
-                      tuple(P(axis) for _ in self._comm),
+                      tuple(
+                          tuple(P(axis) for _ in grp) for grp in self._comm
+                      ),
                       tuple(P(axis) for _ in self._arrays)),
             out_specs=out_specs,
             check_vma=False,
@@ -310,25 +321,25 @@ class DynamicDistAttnRuntime:
         q_kind, k_kind, r_kind = self._q_kind, self._k_kind, self._r_kind
 
         def f(q, k, v, comm, slices):
-            (q_send, q_recv, k_send, k_recv, r_send, r_recv, merge_idx) = (
-                tuple(c[0] for c in comm)
+            q_ops, k_ops, r_ops, (merge_idx,) = tuple(
+                tuple(a[0] for a in grp) for grp in comm
             )
             q_buf = jnp.concatenate(
-                [q, cast_rows(q, (q_send, q_recv), q_kind, axis)], axis=0
+                [q, cast_rows(q, q_ops, q_kind, axis)], axis=0
             )
             k_buf = jnp.concatenate(
-                [k, cast_rows(k, (k_send, k_recv), k_kind, axis)], axis=0
+                [k, cast_rows(k, k_ops, k_kind, axis)], axis=0
             )
             v_buf = jnp.concatenate(
-                [v, cast_rows(v, (k_send, k_recv), k_kind, axis)], axis=0
+                [v, cast_rows(v, k_ops, k_kind, axis)], axis=0
             )
             qr, kr, lo, hi = (a[0] for a in slices)
             out_buf, lse_buf = dense_fn(
                 q_buf, k_buf, v_buf, qr, kr, None,
                 softmax_scale=scale, softcap=softcap, d_lo=lo, d_hi=hi,
             )
-            ret_out = cast_rows(out_buf, (r_send, r_recv), r_kind, axis)
-            ret_lse = cast_rows(lse_buf, (r_send, r_recv), r_kind, axis)
+            ret_out = cast_rows(out_buf, r_ops, r_kind, axis)
+            ret_lse = cast_rows(lse_buf, r_ops, r_kind, axis)
             out, lse = _merge_rows(
                 out_buf, lse_buf, ret_out, ret_lse, merge_idx
             )
@@ -348,7 +359,9 @@ class DynamicDistAttnRuntime:
             f,
             mesh=self.mesh,
             in_specs=(spec, spec, spec,
-                      tuple(P(axis) for _ in self._comm),
+                      tuple(
+                          tuple(P(axis) for _ in grp) for grp in self._comm
+                      ),
                       tuple(P(axis) for _ in slices)),
             out_specs=out_specs,
             check_vma=False,

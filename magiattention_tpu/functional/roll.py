@@ -113,21 +113,22 @@ def roll_rows(
     cp: int,
     axis_name: str,
 ) -> jax.Array:
-    """Segment-wise roll inside shard_map: local gather + ppermute rounds."""
+    """Segment-wise roll inside shard_map: local gather + ppermute rounds
+    (the ring loop is :func:`group_cast_rows_pp` with an identity receive
+    selector; the roll-specific part is only the final [local | received]
+    assembly gather)."""
+    from ..comm.primitives import group_cast_rows_pp
+
     parts = [x]
     if deltas:
-        send = jnp.take(x, send_idx, axis=0)
-        off = 0
-        for delta, c in zip(deltas, caps):
-            perm = [(r, (r + delta) % cp) for r in range(cp)]
-            parts.append(
-                jax.lax.ppermute(
-                    jax.lax.slice_in_dim(send, off, off + c, axis=0),
-                    axis_name,
-                    perm,
-                )
+        sum_caps = sum(caps)
+        parts.append(
+            group_cast_rows_pp(
+                x, send_idx,
+                jnp.arange(sum_caps, dtype=jnp.int32),
+                deltas, caps, cp, axis_name,
             )
-            off += c
+        )
     buf = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
     return jnp.take(buf, asm_idx, axis=0)
 

@@ -103,9 +103,9 @@ class FFAParams:
     group: int  # hq // hk
     interpret: bool
     # emit the per-head max-logits output (ref forward_meta.py:21). Costs an
-    # extra (hq, sqp, 128) fp32 HBM write; turn off when the caller doesn't
-    # ask for it.
-    emit_max_logits: bool = True
+    # extra (hq, sqp, 128) fp32 HBM write, so it is opt-in; when off, the
+    # returned max_logits is a constant -inf placeholder.
+    emit_max_logits: bool = False
 
 
 def plan_arrays(plan: FFAPlan) -> tuple[jax.Array, ...]:

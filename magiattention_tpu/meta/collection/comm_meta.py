@@ -86,6 +86,63 @@ class GroupCollectiveArg:
         return self.wire_rows() / payload if payload else 1.0
 
 
+def build_pp_lowering(
+    pair_counts: np.ndarray,
+    rows_for,
+    recv_parts: list[list[tuple[int, int, int]]],
+    r_max: int,
+    align: int,
+) -> tuple[tuple[int, ...], tuple[int, ...], np.ndarray | None, np.ndarray | None]:
+    """Shared ppermute-lowering planner (used by both the static and the
+    dynamic solver — one implementation of the per-distance packing).
+
+    Args:
+        pair_counts: (cp, cp) [src][dst] row counts.
+        rows_for: callable (src, dst) -> int32 array of local row indices in
+            pair order (only called for non-empty pairs).
+        recv_parts: [dst] -> (src, start_pos_in_pair, n) in buffer order.
+        r_max: padded receive length.
+        align: per-delta capacity alignment.
+
+    Returns:
+        (deltas, caps, pp_send_idx (cp, sum_caps), pp_recv_sel (cp, r_max)),
+        with the arrays None when there is no remote traffic.
+    """
+    cp = pair_counts.shape[0]
+    deltas, caps = [], []
+    for delta in range(1, cp):
+        mx = max(int(pair_counts[s, (s + delta) % cp]) for s in range(cp))
+        if mx > 0:
+            deltas.append(delta)
+            caps.append(-(-mx // align) * align)
+    cum = {}
+    off = 0
+    for delta, c in zip(deltas, caps):
+        cum[delta] = off
+        off += c
+    sum_caps = off
+    if not sum_caps:
+        return (), (), None, None
+    pp_send_idx = np.zeros((cp, sum_caps), dtype=np.int32)
+    for s in range(cp):
+        for delta in deltas:
+            d = (s + delta) % cp
+            n = int(pair_counts[s, d])
+            if n:
+                pp_send_idx[s, cum[delta]: cum[delta] + n] = rows_for(s, d)
+    pp_recv_sel = np.zeros((cp, r_max), dtype=np.int32)
+    for d in range(cp):
+        parts = [
+            cum[(d - src) % cp] + start_pos + np.arange(n, dtype=np.int32)
+            for src, start_pos, n in recv_parts[d]
+            if n
+        ]
+        if parts:
+            flat = np.concatenate(parts)
+            pp_recv_sel[d, : flat.size] = flat
+    return tuple(deltas), tuple(caps), pp_send_idx, pp_recv_sel
+
+
 @dataclass
 class CommMeta:
     """All GroupCast stages of the forward pass (kv; qo-comm adds more).

@@ -436,41 +436,14 @@ class DistAttnSolver:
         # padded only to that distance's max pair — near zero-redundant for
         # skewed traffic (the TPU analogue of true per-pair a2av splits,
         # ref comm/primitive/grpcoll/utils.py:593)
-        pp_align = min(self.split_alignment, 8)
-        deltas, caps = [], []
-        for delta in range(1, cp):
-            mx = max(int(pair_count[s, (s + delta) % cp]) for s in range(cp))
-            if mx > 0:
-                deltas.append(delta)
-                caps.append(_round_up(mx, pp_align))
+        from ..collection.comm_meta import build_pp_lowering
+
+        deltas, caps, pp_send_idx, pp_recv_sel = build_pp_lowering(
+            pair_count,
+            lambda s, d: np.concatenate(send_chunks[s][d]),
+            recv_parts, r_max, min(self.split_alignment, 8),
+        )
         sum_caps = sum(caps)
-        pp_send_idx = pp_recv_sel = None
-        if sum_caps:
-            cum = {}
-            off = 0
-            for delta, c in zip(deltas, caps):
-                cum[delta] = off
-                off += c
-            pp_send_idx = np.zeros((cp, sum_caps), dtype=np.int32)
-            for s in range(cp):
-                for delta in deltas:
-                    d = (s + delta) % cp
-                    n = int(pair_count[s, d])
-                    if n:
-                        pp_send_idx[s, cum[delta]: cum[delta] + n] = (
-                            np.concatenate(send_chunks[s][d])
-                        )
-            pp_recv_sel = np.zeros((cp, r_max), dtype=np.int32)
-            for d in range(cp):
-                parts = [
-                    cum[(d - src) % cp] + start_pos
-                    + np.arange(n, dtype=np.int32)
-                    for src, start_pos, n in recv_parts[d]
-                    if n
-                ]
-                if parts:
-                    flat = np.concatenate(parts)
-                    pp_recv_sel[d, : flat.size] = flat
 
         arg = GroupCollectiveArg(
             transfer_table=transfer_table,

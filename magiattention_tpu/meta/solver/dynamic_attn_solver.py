@@ -407,47 +407,19 @@ def _make_cast_arg(
         if chunks:
             recv_sel[d, :off] = np.concatenate(chunks)
 
-    # ppermute lowering (per-distance padding — see the static solver's
-    # _make_group_collective_arg; picked when it wins on wire rows)
-    pp_align = min(alignment, 8)
-    deltas, caps = [], []
-    for delta in range(1, cp):
-        mx = max(int(pair_rows[s, (s + delta) % cp]) for s in range(cp))
-        if mx > 0:
-            deltas.append(delta)
-            caps.append(_round_up(mx, pp_align))
-    cum = {}
-    off = 0
-    for delta, c in zip(deltas, caps):
-        cum[delta] = off
-        off += c
-    sum_caps = off
-    pp_send_idx = pp_recv_sel = None
-    if sum_caps:
-        pp_send_idx = np.zeros((cp, sum_caps), dtype=np.int32)
-        for s in range(cp):
-            for delta in deltas:
-                d = (s + delta) % cp
-                pos = cum[delta]
-                for loc0, n in send_segs[s][d]:
-                    pp_send_idx[s, pos: pos + n] = np.arange(
-                        loc0, loc0 + n, dtype=np.int32
-                    )
-                    pos += n
-        pp_recv_sel = np.zeros((cp, r_max), dtype=np.int32)
-        for d in range(cp):
-            chunks = []
-            for src, start_pos, n in recv_parts[d]:
-                base = cum[(d - src) % cp]
-                chunks.append(
-                    np.arange(
-                        base + start_pos, base + start_pos + n,
-                        dtype=np.int32,
-                    )
-                )
-            if chunks:
-                flat = np.concatenate(chunks)
-                pp_recv_sel[d, : flat.size] = flat
+    # ppermute lowering (shared planner — see comm_meta.build_pp_lowering)
+    from ..collection.comm_meta import build_pp_lowering
+
+    def _rows_for(s, d):
+        return np.concatenate(
+            [np.arange(loc0, loc0 + n, dtype=np.int32)
+             for loc0, n in send_segs[s][d]]
+        )
+
+    deltas, caps, pp_send_idx, pp_recv_sel = build_pp_lowering(
+        pair_rows, _rows_for, recv_parts, r_max, min(alignment, 8)
+    )
+    sum_caps = sum(caps)
 
     arg = GroupCollectiveArg(
         transfer_table=transfer_table,
