@@ -154,6 +154,43 @@ def run_worker() -> int:
     if sweep_error:
         result["sweep_error"] = sweep_error
 
+    # comm-plan quality (host-side planning, backend-independent): wire
+    # bytes per payload byte for the BASELINE config-3 shape (causal cp=8),
+    # per wire tier — the zero-redundant-communication pillar quantified
+    try:
+        from magiattention_tpu.common.enum import AttnMaskType
+        from magiattention_tpu.common.ranges import AttnRanges
+        from magiattention_tpu.meta import (
+            make_attn_meta_from_dispatch_meta,
+            make_dispatch_meta_from_qk_ranges,
+        )
+
+        SP, CPN = 1 << 15, 8
+        mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+            AttnRanges.from_ranges([[0, SP]]),
+            AttnRanges.from_ranges([[0, SP]]),
+            [AttnMaskType.CAUSAL], SP, SP, SP // 256, CPN,
+        )
+        cmm, _ = make_attn_meta_from_dispatch_meta(bucket, mq)
+        payload = sum(s.payload_rows() for s in cmm.kv_stages)
+        if payload:
+            result["wire_ratio_a2a"] = round(
+                sum(s.wire_rows("a2a") for s in cmm.kv_stages) / payload, 3
+            )
+            result["wire_ratio_pp"] = round(
+                sum(s.wire_rows("ppermute") for s in cmm.kv_stages) / payload,
+                3,
+            )
+            # ragged wire = true per-pair splits = off-diagonal send rows
+            ragged_wire = sum(
+                int(s.send_counts.sum())
+                - int(np.trace(s.send_counts))
+                for s in cmm.kv_stages
+            )
+            result["wire_ratio_ragged"] = round(ragged_wire / payload, 3)
+    except Exception as e:  # noqa: BLE001
+        result["wire_ratio_error"] = f"{type(e).__name__}: {e}"[:120]
+
     if backend == "cpu":
         # degraded path: attach the last successful TPU measurement (if
         # any) so a flaky-chip round still reports the real number

@@ -150,11 +150,20 @@ class DistAttnRuntimeMgr:
         # the runtime may override the solver's portable lowering with the
         # backend-dependent ragged/hier tier — report what actually runs
         kinds = getattr(self.runtime, "_cast_kinds", None)
+        names = {"pp": "ppermute", "a2a": "a2a", "ragged": "ragged",
+                 "hier": "hier"}
         for st, s in enumerate(cm.kv_stages):
-            executed = kinds[st][0] if kinds and st < len(kinds) else s.lowering
-            wire = (
-                s.payload_rows() if executed == "ragged" else s.wire_rows()
+            executed = (
+                names.get(kinds[st][0], kinds[st][0])
+                if kinds and st < len(kinds)
+                else s.lowering
             )
+            if executed == "ragged":
+                wire = s.payload_rows()
+            elif executed == s.lowering:
+                wire = s.wire_rows()
+            else:  # e.g. hier: flat wire numbers would be misleading
+                wire = s.wire_rows(s.lowering)
             logger.info(
                 "comm plan stage %d/%d: executed=%s planned=%s "
                 "payload_rows=%d wire_rows=%d ratio=%.3f (a2a would be %d) "
