@@ -1,0 +1,177 @@
+"""FFA kernel benchmark grid (ref: docs/source/blog/cp_benchmark.md:82-96).
+
+The reference's kernel-bench coverage: 6 masks (full, causal, varlen full,
+varlen causal, sliding-window causal, Magi-1 video block causal), seqlen
+sweep, fwd and fwd+bwd, TFLOP/s with FLOPs = 4 * mask_area * d * hq (bwd
+2.5x). Chained-scan timing (tunnel-cache-proof).
+
+    python benchmarks/kernel_bench.py --seqlens 4096,8192 --dtype bf16
+    python benchmarks/kernel_bench.py --cpu --seqlens 512   # smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def build_mask(name: str, s: int):
+    """Returns (q_ranges, k_ranges, type_map, area)."""
+    import numpy as np
+
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.mask import AttnMask
+    from magiattention_tpu.common.ranges import AttnRanges
+
+    if name == "full":
+        qr, kr, tm = [[0, s]], [[0, s]], [0]
+    elif name == "causal":
+        qr, kr, tm = [[0, s]], [[0, s]], [1]
+    elif name in ("varlen_full", "varlen_causal"):
+        t = 0 if name == "varlen_full" else 1
+        bounds = [0, s // 8, s // 3, s // 2, (3 * s) // 4, s]
+        qr = [[a, b] for a, b in zip(bounds[:-1], bounds[1:])]
+        kr = qr
+        tm = [t] * len(qr)
+    elif name == "sw_causal":
+        from magiattention_tpu.api.functools import (
+            infer_attn_mask_from_sliding_window,
+        )
+
+        q = AttnRanges.from_ranges([[0, s]])
+        qo, ko, to = infer_attn_mask_from_sliding_window(
+            q, q, [AttnMaskType.CAUSAL], window_size=(s // 8, 0),
+            sink_size=64,
+        )
+        qr = [[r.start, r.end] for r in qo]
+        kr = [[r.start, r.end] for r in ko]
+        tm = [t.to_int_type() for t in to]
+    elif name == "video":
+        from magiattention_tpu.utils.sparse_utils import (
+            block_mask_to_ranges, make_video_block_mask,
+        )
+
+        frames = 8
+        per_frame = s // frames
+        block = max(min(per_frame // 2, 1024), 16)
+        bm = make_video_block_mask(frames, per_frame // block, 2)
+        qo, ko, to = block_mask_to_ranges(bm, block, block)
+        qr = [[r.start, r.end] for r in qo]
+        kr = [[r.start, r.end] for r in ko]
+        tm = [t.to_int_type() for t in to]
+    else:
+        raise ValueError(name)
+
+    area = AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        [AttnMaskType.from_int_type(t) for t in tm],
+        total_seqlen_q=s, total_seqlen_k=s,
+    ).area
+    return (
+        np.array(qr, np.int32), np.array(kr, np.int32),
+        np.array(tm, np.int32), area,
+    )
+
+
+MASKS = ["full", "causal", "varlen_full", "varlen_causal", "sw_causal",
+         "video"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqlens", default="4096")
+    ap.add_argument("--masks", default=",".join(MASKS))
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--kv-heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"])
+    ap.add_argument("--backward", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        os.environ.setdefault("MAGI_ATTENTION_PALLAS_INTERPRET", "1")
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from magiattention_tpu.kernels.ffa import ffa_attn
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    HQ, HK, D = args.heads, args.kv_heads, args.head_dim
+    peak = 197.0
+
+    def scan_time(body, init, length=6, reps=2):
+        @jax.jit
+        def run(x):
+            return jax.lax.scan(
+                lambda c, _: (body(c), None), x, None, length=length
+            )[0]
+
+        jax.block_until_ready(run(init))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(init))
+            best = min(best, time.perf_counter() - t0)
+        return best / length * 1e3
+
+    rows = []
+    rng = np.random.default_rng(0)
+    for s in (int(x) for x in args.seqlens.split(",")):
+        q0 = jnp.asarray(rng.standard_normal((s, HQ, D)), dtype)
+        k = jnp.asarray(rng.standard_normal((s, HK, D)), dtype)
+        v = jnp.asarray(rng.standard_normal((s, HK, D)), dtype)
+        w = jnp.asarray(rng.standard_normal((s, HQ, D)), dtype)
+        for name in args.masks.split(","):
+            try:
+                qr, kr, tm, area = build_mask(name, s)
+                flops = 4 * area * D * HQ
+
+                dt = scan_time(
+                    lambda qq: ffa_attn(qq, k, v, qr, kr, tm)[0].astype(dtype),
+                    q0,
+                )
+                row = {
+                    "mask": name, "seqlen": s,
+                    "fwd_ms": round(dt, 3),
+                    "fwd_tflops": round(flops / (dt * 1e-3) / 1e12, 2),
+                    "fwd_mfu": round(flops / (dt * 1e-3) / 1e12 / peak, 4),
+                }
+                if args.backward:
+                    def loss(qq):
+                        o, _ = ffa_attn(qq, k, v, qr, kr, tm)
+                        return jnp.sum(
+                            o.astype(jnp.float32) * w.astype(jnp.float32)
+                        )
+
+                    g = jax.grad(loss)
+                    dtb = scan_time(
+                        lambda qq: (qq + 1e-3 * g(qq).astype(dtype)).astype(dtype),
+                        q0,
+                    )
+                    row["fwdbwd_ms"] = round(dtb, 3)
+                    row["fwdbwd_tflops"] = round(
+                        flops * 3.5 / (dtb * 1e-3) / 1e12, 2
+                    )
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(json.dumps({
+                    "mask": name, "seqlen": s,
+                    "error": f"{type(e).__name__}: {e}"[:160],
+                }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
