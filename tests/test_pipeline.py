@@ -49,6 +49,11 @@ CASES = {
         [[0, 128], [0, 128], [128, S]],
         [FULL, FULL, CAUSAL],
     ),
+    "inv_causal_mix": (  # prefix-lm style: inv-causal doc + causal doc
+        [[0, 128], [128, S]],
+        [[0, 128], [128, S]],
+        [INV, CAUSAL],
+    ),
 }
 
 
