@@ -31,6 +31,10 @@ def main() -> int:
                     help="also train a dense-attention twin and compare")
     ap.add_argument("--tpu", action="store_true",
                     help="run on the attached TPU instead of a CPU mesh")
+    ap.add_argument("--save-dir", default=None,
+                    help="orbax checkpoint dir: resume if present, save at "
+                         "the end (the reference delegates checkpointing to "
+                         "the host framework; here it is orbax)")
     args = ap.parse_args()
 
     import jax
@@ -81,6 +85,17 @@ def main() -> int:
 
     optimizer = optax.adamw(args.lr)
     params = init_params(cfg, jax.random.key(0))
+
+    ckptr = None
+    if args.save_dir:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckpt_path = Path(args.save_dir).resolve() / "params"
+        if ckpt_path.exists():
+            params = ckptr.restore(ckpt_path, params)
+            print(f"resumed params from {ckpt_path}")
+
     params_dense = jax.tree.map(jnp.copy, params) if args.parity else None
     params = shard_params(params, mesh, "cp")
     step = make_optax_train_step(cfg, key, optimizer)
@@ -111,6 +126,11 @@ def main() -> int:
                 f"  |diff| {abs(float(loss) - float(loss_d)):.2e}"
             )
         print(line, flush=True)
+    if ckptr is not None:
+        ckpt_path = Path(args.save_dir).resolve() / "params"
+        ckptr.save(ckpt_path, params, force=True)
+        ckptr.wait_until_finished()
+        print(f"saved params to {ckpt_path}")
     print("done")
     return 0
 
