@@ -46,6 +46,16 @@ def run_worker() -> int:
 
     import jax
 
+    try:
+        # reuse Mosaic executables compiled in earlier runs/windows — first
+        # compile is 20-40s per kernel variant, which a flaky chip window
+        # may not have
+        from magiattention_tpu.utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()
+    except Exception:
+        pass
+
     if os.environ.get("MAGI_BENCH_FORCE_CPU") == "1":
         # the axon sitecustomize force-sets JAX_PLATFORMS=axon, overriding
         # the env var — only jax.config reliably pins the degraded path to

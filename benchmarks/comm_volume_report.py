@@ -1,0 +1,237 @@
+"""Planned communication-volume comparison: Magi CP wire tiers vs CP baselines.
+
+Quantifies the zero-redundant-communication pillar (reference README.md:67-72;
+its distributed bench cp_benchmark.md:384-404 shows the result as TFLOP/s —
+this report shows the *cause*: bytes on the wire). Everything here is
+host-side planning, so it is exact and chip-independent.
+
+Per config it reports forward remote-KV bytes per rank (the backward dKV
+GroupReduce is the AD transpose of the same plan, so bwd volume is identical;
+qo-comm moves q/o instead and is benched separately):
+
+- magi payload  — rows the plan actually needs (the zero-redundancy floor)
+- magi a2a/pp/ragged — rows on the wire under each lowering tier
+- ring / allgather   — (cp-1)/cp x full KV per rank (P2P ring passes every
+  shard through every rank; allgather materializes all of it)
+- ulysses            — head-scatter all-to-alls for q,k,v,o (volume is
+  mask-independent, but cp is capped by head count)
+
+Usage:
+    python benchmarks/comm_volume_report.py [--write-doc]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from magiattention_tpu.api.functools import (  # noqa: E402
+    infer_attn_mask_from_sliding_window,
+)
+from magiattention_tpu.common.enum import AttnMaskType  # noqa: E402
+from magiattention_tpu.common.ranges import AttnRanges  # noqa: E402
+from magiattention_tpu.meta import (  # noqa: E402
+    make_attn_meta_from_dispatch_meta,
+    make_dispatch_meta_from_qk_ranges,
+)
+from magiattention_tpu.utils.sparse_utils import (  # noqa: E402
+    block_mask_to_ranges,
+    make_video_block_mask,
+)
+from magiattention_tpu.common.enum import DispatchAlgType  # noqa: E402
+from magiattention_tpu.config import DispatchConfig  # noqa: E402
+
+BYTES = 2  # bf16
+HK, D, DV = 8, 128, 128  # GQA kv heads; a token row of fused K|V
+ROW_BYTES = HK * (D + DV) * BYTES
+
+
+def magi_rows(qr, kr, tm, s, cp, chunk, alg=DispatchAlgType.MIN_HEAP):
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr), tm,
+        s, s, chunk, cp,
+        dispatch_config=DispatchConfig(alg=alg),
+    )
+    cmm, _ = make_attn_meta_from_dispatch_meta(bucket, mq)
+    payload = sum(a.payload_rows() for a in cmm.kv_stages)
+    a2a = sum(a.wire_rows("a2a") for a in cmm.kv_stages)
+    pp = sum(a.wire_rows("ppermute") for a in cmm.kv_stages)
+    ragged = sum(
+        int(a.send_counts.sum()) - int(np.trace(a.send_counts))
+        for a in cmm.kv_stages
+    )
+    areas = np.asarray(bucket.areas_per_chunk, dtype=np.float64)
+    rank_areas = [areas[list(p)].sum() for p in mq.partitions]
+    imbalance = max(rank_areas) / (sum(rank_areas) / cp) if sum(rank_areas) else 1.0
+    return payload, a2a, pp, ragged, imbalance
+
+
+def config_rows(name, s, cp, chunk):
+    """(q_ranges, k_ranges, types) for each named BASELINE config."""
+    if name == "full":
+        return [[0, s]], [[0, s]], [AttnMaskType.FULL]
+    if name == "causal":
+        return [[0, s]], [[0, s]], [AttnMaskType.CAUSAL]
+    if name == "sliding-window":
+        qr, kr, tm = infer_attn_mask_from_sliding_window(
+            AttnRanges.from_ranges([[0, s]]),
+            AttnRanges.from_ranges([[0, s]]),
+            [AttnMaskType.CAUSAL],
+            window_size=(8192, 0),
+            sink_size=0,
+        )
+        return (
+            [[r.start, r.end] for r in qr],
+            [[r.start, r.end] for r in kr],
+            tm,
+        )
+    if name == "video":
+        # Magi-1 spatiotemporal block mask (BASELINE config 4 shape family)
+        block = 512
+        frames = 8
+        bm = make_video_block_mask(frames, s // frames // block, 2)
+        qr, kr, tm = block_mask_to_ranges(bm, block, block)
+        return (
+            [[r.start, r.end] for r in qr],
+            [[r.start, r.end] for r in kr],
+            list(tm),
+        )
+    raise ValueError(name)
+
+
+def gb(rows: int, cp: int) -> float:
+    """whole-mesh rows -> GB per rank."""
+    return rows * ROW_BYTES / cp / 1e9
+
+
+ALGS = {
+    "min-heap": DispatchAlgType.MIN_HEAP,
+    "topp-heap": DispatchAlgType.TOPP_HEAP,
+    "sequential": DispatchAlgType.SEQUENTIAL_SELECT,
+    "auto": DispatchAlgType.AUTO,
+}
+
+
+def report(configs) -> list[dict]:
+    out = []
+    for name, s, cp in configs:
+        chunk = max(512, s // 256)
+        qr, kr, tm = config_rows(name, s, cp, chunk)
+        # the dispatch algorithm controls the balance<->locality trade-off:
+        # MIN_HEAP balances area ignoring locality; TOPP_HEAP tie-breaks by
+        # KV-overlap (IOU) affinity; SEQUENTIAL keeps contiguous blocks
+        # (max locality, no balancing) — ref dispatch_solver.py:62-357
+        by_alg = {}
+        for alg_name, alg in ALGS.items():
+            payload, a2a, pp, ragged, imb = magi_rows(
+                qr, kr, tm, s, cp, chunk, alg
+            )
+            by_alg[alg_name] = {
+                "payload": payload, "a2a": a2a, "pp": pp,
+                "ragged": ragged, "imbalance": imb,
+            }
+        shard = s // cp
+        ring_rows = cp * (s - shard)  # whole mesh: each rank gets all-but-own
+        # ulysses: 4 tensors (q,o: HQ=2*HK heads; k,v: HK heads) head-scatter;
+        # per-rank send rows x token-row-bytes equivalent:
+        hq = 2 * HK
+        uly_bytes_rank = (
+            s / cp * D * BYTES * (2 * hq + 2 * HK) * (cp - 1) / cp
+        )
+        out.append(
+            {
+                "config": name,
+                "seqlen": s,
+                "cp": cp,
+                "by_alg": by_alg,
+                "ring_gb": gb(ring_rows, cp),
+                "ulysses_gb": uly_bytes_rank / 1e9,
+            }
+        )
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write-doc", action="store_true")
+    ap.add_argument("--fast", action="store_true", help="small configs only")
+    args = ap.parse_args()
+
+    configs = [
+        ("full", 1 << 18, 8),
+        ("causal", 1 << 18, 8),
+        ("sliding-window", 1 << 18, 8),
+        ("video", 1 << 17, 8),
+    ]
+    if args.fast:
+        configs = [(n, s >> 3, cp) for n, s, cp in configs]
+
+    rows = report(configs)
+
+    hdr = (
+        "| config | seq | dispatch alg | payload | ragged | ppermute | a2a "
+        "| balance | ring/allgather | ulysses |"
+    )
+    sep = "|" + "---|" * 10
+    lines = [hdr, sep]
+    for r in rows:
+        for i, (alg_name, v) in enumerate(r["by_alg"].items()):
+            cp = r["cp"]
+            lines.append(
+                f"| {r['config'] if i == 0 else ''} "
+                f"| {r['seqlen'] if i == 0 else ''} | {alg_name} "
+                f"| {gb(v['payload'], cp):.3f} | {gb(v['ragged'], cp):.3f} "
+                f"| {gb(v['pp'], cp):.3f} | {gb(v['a2a'], cp):.3f} "
+                f"| {v['imbalance']:.2f}x "
+                f"| {r['ring_gb']:.3f} | {r['ulysses_gb']:.3f} |"
+            )
+    table = "\n".join(lines)
+    print(table)
+
+    if args.write_doc:
+        doc = Path(__file__).resolve().parents[1] / "docs" / "comm_volume.md"
+        doc.write_text(
+            "# Planned communication volume (GB per rank, forward remote-KV"
+            " cast)\n\n"
+            "Generated by `python benchmarks/comm_volume_report.py"
+            " --write-doc`.\n"
+            "All numbers are exact host-side plans (bf16, hk=8, d=dv=128;"
+            " backward dKV\nGroupReduce volume is identical — it is the AD"
+            " transpose of the same plan).\n\n"
+            "- **payload** — rows the mask actually requires: the"
+            " zero-redundancy floor.\n"
+            "- **ragged / ppermute / a2a** — magi wire volume under each"
+            " lowering tier\n  (ragged_all_to_all = true per-pair splits;"
+            " ppermute = per-ring-distance\n  padding; a2a = dense equal-split"
+            " all_to_all padded to the max pair).\n"
+            "- **ring/allgather** — every rank receives all non-local KV"
+            " regardless of\n  mask: the baselines' mask-independent cost.\n"
+            "- **ulysses** — head-scatter a2a of q,k,v,o (mask-independent;"
+            " cp capped by\n  kv heads = 8 here).\n"
+            "- **balance** — max rank attention-area over the mean (1.00 ="
+            " perfect\n  load balance); the dispatch algorithm trades comm"
+            " locality against it.\n\n" + table + "\n\n"
+            "Reading: the ragged tier is within alignment padding of the"
+            " payload floor\nunder every algorithm — the TPU counterpart of"
+            " the reference's zero-redundant\ngrpcoll"
+            " (magi_attention/comm/primitive/grpcoll/utils.py:593 per-pair"
+            " splits).\nWhat the floor itself is depends on dispatch"
+            " locality: on local masks\n(sliding-window) SEQUENTIAL keeps"
+            " chunks contiguous and needs only the\nwindow overlap at shard"
+            " boundaries — orders of magnitude below ring — while\nstaying"
+            " balanced because the per-chunk area is uniform. On causal"
+            " masks\nMIN_HEAP/TOPP_HEAP pay more comm than SEQUENTIAL but fix"
+            " its 1.75x area\nimbalance, which would cost more wall-clock"
+            " than the extra bytes.\n"
+        )
+        print(f"\nwrote {doc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,10 @@ class AttnOverlapMode(Enum):
 class DispatchAlgType(Enum):
     """Algorithm for load-balanced chunk->rank dispatching."""
 
+    # AUTO is this build's addition (no reference analogue): solve with a
+    # small candidate set and pick by a modeled compute/comm trade-off —
+    # see meta/_make_dispatch_meta.py:_auto_select_partitions
+    AUTO = "auto"
     LOWER_BOUND = "lower_bound"
     DYNAMIC_PROGRAMMING = "dynamic_programming"
     BINARY_SEARCH = "binary_search"

@@ -23,6 +23,14 @@ class DispatchConfig:
         max_backtracks: node budget for BACKTRACKING_PRUNING.
         uneven_shard: allow ranks to own different chunk counts (shards are
             padded to the max on-device; ref DispatchConfig.uneven_shard).
+        auto_comm_area_per_row: AUTO-mode cost model — attention-area units
+            one remote-KV row costs in wall-clock. Default derived for v5e
+            ICI: a K|V row (hk=8, d=dv=128, bf16 = 4 KiB) at ~90 GB/s is
+            ~45 ns, one area unit (fwd+bwd ~28 kFLOP at hq=16, d=128) at
+            197 TFLOP/s is ~0.15 ns -> ~300. Raise for DCN-dominated
+            meshes, lower for small heads.
+        auto_tol: AUTO-mode relative cost tolerance within which the
+            candidate moving fewer total rows wins the tie.
     """
 
     alg: DispatchAlgType = DispatchAlgType.MIN_HEAP
@@ -30,6 +38,8 @@ class DispatchConfig:
     top_p: float = 0.25
     max_backtracks: int = 10_000
     uneven_shard: bool = False
+    auto_comm_area_per_row: float = 300.0
+    auto_tol: float = 0.05
 
 
 @dataclass(frozen=True)

@@ -7,6 +7,8 @@ DispatchMeta with permutation indices.
 
 from __future__ import annotations
 
+import logging
+
 from .. import env as _env
 from ..common.enum import AttnMaskType, AttnType, DispatchAlgType
 from ..common.range import AttnRange
@@ -15,6 +17,8 @@ from .collection.dispatch_meta import DispatchMeta
 from .container.bucket import AttnBucket, AttnChunk
 from .container.slice import AttnSlice
 from .solver.dispatch_solver import DispatchConfig, DispatchSolver
+
+_logger = logging.getLogger("magiattention_tpu.dispatch")
 
 
 def make_global_bucket_from_qk_ranges(
@@ -53,6 +57,159 @@ def make_global_bucket_from_qk_ranges(
             if not clipped.q_range.is_empty() and clipped.area > 0:
                 chunks[c].attn_slices.append(clipped)
     return AttnBucket(cp_rank=None, q_chunks=chunks)
+
+
+def _solve_partitions_with_alg(
+    bucket: AttnBucket,
+    areas: list[int],
+    cp_size: int,
+    num_chunks: int,
+    dispatch_config: DispatchConfig,
+    alg: DispatchAlgType,
+) -> list[list[int]]:
+    """Chunk->rank partitions under one concrete algorithm."""
+    if (
+        alg == DispatchAlgType.MIN_HEAP
+        and not dispatch_config.uneven_shard
+        and _env.general.is_cpp_backend_enable()
+    ):
+        try:  # native hot loop (csrc/magi_host.cpp magi_minheap_solve)
+            from ..csrc_backend.ops import minheap_solve_native
+            import numpy as _np
+
+            return [
+                sorted(p)
+                for p in minheap_solve_native(
+                    _np.asarray(areas, dtype=_np.int64),
+                    cp_size,
+                    num_chunks // cp_size,
+                )
+            ]
+        except ImportError:
+            pass
+    solver = DispatchSolver(alg=alg, config=dispatch_config)
+    affinities = None
+    if alg in (
+        DispatchAlgType.TOPP_HEAP,
+        DispatchAlgType.BATCH_TOPP_HEAP,
+    ) and not dispatch_config.uneven_shard:
+        # (the uneven solve path balances by pure LPT and does not
+        # consume affinities)
+        # IOU affinity: each chunk's kv coverage — co-locating
+        # overlapping coverage deduplicates GroupCast volume
+        from .solver.dispatch_solver import IOUAffinity
+
+        affinities = [
+            IOUAffinity.from_ranges(
+                AttnRanges(
+                    [AttnRange(s.k_range.start, s.k_range.end)
+                     for s in chunk.attn_slices]
+                )
+            )
+            for chunk in bucket.q_chunks
+        ]
+    return solver.solve(areas, cp_size, affinities=affinities).partitions
+
+
+def estimate_remote_rows_per_rank(
+    bucket: AttnBucket,
+    partitions: list[list[int]],
+    kv_own_ranges: list[AttnRanges] | None = None,
+) -> list[int]:
+    """Per-rank remote-KV row estimate for a candidate chunk assignment.
+
+    For each rank: the union of its chunks' band-effective k coverage
+    (AttnSlice.needed_k_range), minus the KV rows the rank itself owns.
+    Ownership defaults to the rank's own q ranges (self-attention: kv
+    follows the q assignment); cross-attention callers pass the sequential
+    kv shard ownership via ``kv_own_ranges``. This is the GroupCast payload
+    the dist_attn_solver will plan, estimated without running the solver —
+    cheap enough to evaluate several candidate dispatches.
+    """
+    out = []
+    for r, part in enumerate(partitions):
+        if kv_own_ranges is not None:
+            own = kv_own_ranges[r]
+        else:
+            own = AttnRanges(
+                [bucket.q_chunks[c].q_range for c in part]
+            ).merge()
+        need = AttnRanges(
+            [
+                s.needed_k_range()
+                for c in part
+                for s in bucket.q_chunks[c].attn_slices
+            ]
+        ).merge()
+        out.append(need.total_seqlen - need.intersect_size_with(own))
+    return out
+
+
+def _auto_select_partitions(
+    bucket: AttnBucket,
+    areas: list[int],
+    cp_size: int,
+    num_chunks: int,
+    dispatch_config: DispatchConfig,
+    kv_own_ranges: list[AttnRanges] | None = None,
+) -> tuple[list[list[int]], DispatchAlgType]:
+    """AUTO dispatch: pick the algorithm by a modeled compute/comm cost.
+
+    This build's addition (the reference leaves the algorithm to the user,
+    dispatch_solver.py:359). Rationale: the best algorithm depends on the
+    mask — MIN_HEAP perfectly balances area but scatters chunks, which on
+    *local* masks (sliding-window, block-local video) inflates remote-KV
+    volume by an order of magnitude over SEQUENTIAL, whose balance on those
+    masks is already near-perfect (see benchmarks/comm_volume_report.py).
+
+    Model: rank busy-time = max(area_r, comm_area_per_row * remote_rows_r)
+    (comm overlaps compute in the multi-stage runtime); mesh cost = max over
+    ranks. A candidate replaces the incumbent when it is clearly cheaper
+    (rel. auto_tol), or stays within tolerance *of the cheapest cost seen*
+    and moves fewer total rows (anchoring to the minimum prevents the
+    tolerance from ratcheting across candidates).
+    """
+    candidates = [
+        DispatchAlgType.MIN_HEAP,
+        DispatchAlgType.TOPP_HEAP,
+        DispatchAlgType.SEQUENTIAL_SELECT,
+    ]
+    lam = dispatch_config.auto_comm_area_per_row
+    tol = dispatch_config.auto_tol
+    best = None  # (cost, total_rows, partitions, alg)
+    min_cost = None
+    seen: set[tuple] = set()
+    for alg in candidates:
+        parts = _solve_partitions_with_alg(
+            bucket, areas, cp_size, num_chunks, dispatch_config, alg
+        )
+        # under uneven_shard several candidates collapse to the same LPT
+        # partition — don't estimate (or "select") duplicates
+        sig = tuple(tuple(p) for p in parts)
+        if sig in seen:
+            continue
+        seen.add(sig)
+        remote = estimate_remote_rows_per_rank(
+            bucket, parts, kv_own_ranges=kv_own_ranges
+        )
+        rank_area = [sum(areas[c] for c in p) for p in parts]
+        cost = max(
+            max(a, lam * r) for a, r in zip(rank_area, remote)
+        )
+        rows = sum(remote)
+        min_cost = cost if min_cost is None else min(min_cost, cost)
+        if (
+            best is None
+            or cost < best[0] * (1 - tol)
+            or (cost <= min_cost * (1 + tol) and rows < best[1])
+        ):
+            best = (cost, rows, parts, alg)
+    assert best is not None  # MIN_HEAP always solves
+    _logger.info(
+        "AUTO dispatch chose %s (modeled cost %.3g, est. remote rows %d)",
+        best[3].value, best[0], best[1],
+    )
+    return best[2], best[3]
 
 
 def make_dispatch_meta_from_qk_ranges(
@@ -94,54 +251,30 @@ def make_dispatch_meta_from_qk_ranges(
         partitions = [sorted(p) for p in preset_partitions]
     elif cp_size == 1:
         partitions = [list(range(num_chunks))]
+    elif dispatch_config.alg == DispatchAlgType.AUTO:
+        kv_own = None
+        if total_seqlen_k != total_seqlen_q:
+            # cross-attn: kv ownership is the sequential even shard in
+            # k-space (see meta_kv below), not the rank's q ranges
+            if total_seqlen_k % cp_size != 0:
+                raise ValueError(
+                    f"total_seqlen_k {total_seqlen_k} not divisible by "
+                    f"cp_size"
+                )
+            sz = total_seqlen_k // cp_size
+            kv_own = [
+                AttnRanges([AttnRange(r * sz, (r + 1) * sz)])
+                for r in range(cp_size)
+            ]
+        partitions, _ = _auto_select_partitions(
+            bucket, areas, cp_size, num_chunks, dispatch_config,
+            kv_own_ranges=kv_own,
+        )
     else:
-        partitions = None
-        if (
-            dispatch_config.alg == DispatchAlgType.MIN_HEAP
-            and not dispatch_config.uneven_shard
-            and _env.general.is_cpp_backend_enable()
-        ):
-            try:  # native hot loop (csrc/magi_host.cpp magi_minheap_solve)
-                from ..csrc_backend.ops import minheap_solve_native
-                import numpy as _np
-
-                partitions = [
-                    sorted(p)
-                    for p in minheap_solve_native(
-                        _np.asarray(areas, dtype=_np.int64),
-                        cp_size,
-                        num_chunks // cp_size,
-                    )
-                ]
-            except ImportError:
-                partitions = None
-        if partitions is None:
-            solver = DispatchSolver(
-                alg=dispatch_config.alg, config=dispatch_config
-            )
-            affinities = None
-            if dispatch_config.alg in (
-                DispatchAlgType.TOPP_HEAP,
-                DispatchAlgType.BATCH_TOPP_HEAP,
-            ) and not dispatch_config.uneven_shard:
-                # (the uneven solve path balances by pure LPT and does not
-                # consume affinities)
-                # IOU affinity: each chunk's kv coverage — co-locating
-                # overlapping coverage deduplicates GroupCast volume
-                from .solver.dispatch_solver import IOUAffinity
-
-                affinities = [
-                    IOUAffinity.from_ranges(
-                        AttnRanges(
-                            [AttnRange(s.k_range.start, s.k_range.end)
-                             for s in chunk.attn_slices]
-                        )
-                    )
-                    for chunk in bucket.q_chunks
-                ]
-            partitions = solver.solve(
-                areas, cp_size, affinities=affinities
-            ).partitions
+        partitions = _solve_partitions_with_alg(
+            bucket, areas, cp_size, num_chunks, dispatch_config,
+            dispatch_config.alg,
+        )
 
     is_cross = total_seqlen_k != total_seqlen_q
     meta_q = DispatchMeta(

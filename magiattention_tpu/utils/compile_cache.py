@@ -1,0 +1,37 @@
+"""Persistent XLA/Mosaic compilation cache.
+
+The tunnel TPU comes and goes in short windows; first-compile of each kernel
+variant costs 20-40s, which can eat an entire window. Enabling JAX's
+persistent compilation cache (keyed by backend + HLO + flags) makes every
+process after the first reuse the compiled executable — across the smoke
+script, the block sweep, bench.py, and the driver's round-end bench run.
+
+Reference analogue: the JIT build cache (magi_attention/common/jit/core.py,
+keyed by env snapshot env/ffa.py:125) — same role, compiler-level.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Turn on the JAX persistent compilation cache (idempotent).
+
+    Call before the first jit/pallas compilation. Honors
+    ``JAX_COMPILATION_CACHE_DIR`` if already set; otherwise uses
+    ``<repo>/.jax_cache``.
+    """
+    import jax
+
+    path = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT_DIR
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path

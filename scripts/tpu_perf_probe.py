@@ -10,6 +10,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+try:
+    from magiattention_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+except Exception:
+    pass  # cache dir not writable: run uncached
 import jax.numpy as jnp
 import numpy as np
 

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Persistent TPU experiment queue for flaky chip windows.
+#
+# Probes the tunnel TPU every 2 minutes with a short-timeout matmul; when the
+# chip responds, runs the full experiment queue (smoke -> bench -> block
+# sweep) once and exits. All compiles go through the persistent compilation
+# cache (.jax_cache) so a later window -- or the driver's round-end bench --
+# skips recompiles.
+#
+# Logs: .tpu_logs/{queue.log,smoke.log,bench.log,probe.log}
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p .tpu_logs
+LOG=.tpu_logs/queue.log
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
+while true; do
+  echo "[$(date -u +%H:%M:%S)] probe" >> "$LOG"
+  if timeout 90 python -c "
+import os; os.environ.pop('JAX_PLATFORMS', None)
+import jax; assert jax.default_backend()=='tpu'
+import jax.numpy as jnp
+x = jnp.ones((128,128)) @ jnp.ones((128,128))
+x.block_until_ready()
+" >> "$LOG" 2>&1; then
+    echo "[$(date -u +%H:%M:%S)] CHIP UP — running queue" >> "$LOG"
+    timeout 1500 python -u scripts/tpu_smoke.py > .tpu_logs/smoke.log 2>&1
+    echo "[$(date -u +%H:%M:%S)] smoke rc=$?" >> "$LOG"
+    timeout 1800 python -u bench.py > .tpu_logs/bench.log 2>&1
+    echo "[$(date -u +%H:%M:%S)] bench rc=$?" >> "$LOG"
+    timeout 2400 python -u scripts/tpu_perf_probe.py > .tpu_logs/probe.log 2>&1
+    echo "[$(date -u +%H:%M:%S)] perf-probe rc=$?" >> "$LOG"
+    echo "QUEUE DONE" >> "$LOG"
+    exit 0
+  fi
+  sleep 120
+done

@@ -1,0 +1,188 @@
+"""AUTO dispatch-algorithm selection (this build's addition; the reference
+leaves the algorithm choice to the user, dispatch_solver.py:359).
+
+The selector must pick locality (SEQUENTIAL) on local masks where balance is
+already near-perfect, and balance (MIN_HEAP) on causal masks where
+SEQUENTIAL's area imbalance would dominate wall-clock.
+"""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.api.functools import infer_attn_mask_from_sliding_window
+from magiattention_tpu.common.enum import AttnMaskType, DispatchAlgType
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.config import DispatchConfig
+from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+from magiattention_tpu.meta._make_dispatch_meta import (
+    _auto_select_partitions,
+    estimate_remote_rows_per_rank,
+    make_global_bucket_from_qk_ranges,
+)
+
+S, CP = 1 << 14, 8
+CHUNK = S // 128
+CFG = DispatchConfig(alg=DispatchAlgType.AUTO)
+
+
+def _auto(qr, kr, tm):
+    bucket = make_global_bucket_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr), tm, S, CHUNK
+    )
+    areas = bucket.areas_per_chunk
+    parts, alg = _auto_select_partitions(bucket, areas, CP, len(areas), CFG)
+    return bucket, areas, parts, alg
+
+
+def _sliding():
+    qr, kr, tm = infer_attn_mask_from_sliding_window(
+        AttnRanges.from_ranges([[0, S]]),
+        AttnRanges.from_ranges([[0, S]]),
+        [AttnMaskType.CAUSAL],
+        window_size=(1024, 0),
+    )
+    return (
+        [[r.start, r.end] for r in qr],
+        [[r.start, r.end] for r in kr],
+        tm,
+    )
+
+
+def test_causal_prefers_balance():
+    _, areas, parts, alg = _auto(
+        [[0, S]], [[0, S]], [AttnMaskType.CAUSAL]
+    )
+    assert alg == DispatchAlgType.MIN_HEAP
+    rank_areas = [sum(areas[c] for c in p) for p in parts]
+    assert max(rank_areas) / (sum(rank_areas) / CP) < 1.05
+
+
+def test_sliding_window_prefers_locality():
+    bucket, areas, parts, alg = _auto(*_sliding())
+    assert alg == DispatchAlgType.SEQUENTIAL_SELECT
+    # locality must not cost balance on this mask
+    rank_areas = [sum(areas[c] for c in p) for p in parts]
+    assert max(rank_areas) / (sum(rank_areas) / CP) < 1.10
+
+
+def test_sliding_window_beats_min_heap_on_rows():
+    from magiattention_tpu.meta._make_dispatch_meta import (
+        _solve_partitions_with_alg,
+    )
+
+    qr, kr, tm = _sliding()
+    bucket = make_global_bucket_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr), tm, S, CHUNK
+    )
+    areas = bucket.areas_per_chunk
+    auto_parts, _ = _auto_select_partitions(
+        bucket, areas, CP, len(areas), CFG
+    )
+    mh_parts = _solve_partitions_with_alg(
+        bucket, areas, CP, len(areas), CFG, DispatchAlgType.MIN_HEAP
+    )
+    auto_rows = sum(estimate_remote_rows_per_rank(bucket, auto_parts))
+    mh_rows = sum(estimate_remote_rows_per_rank(bucket, mh_parts))
+    assert auto_rows * 4 < mh_rows  # at least 4x less remote traffic
+
+
+def test_estimator_matches_planned_payload():
+    """The cheap estimator must agree with the dist_attn_solver's plan."""
+    from magiattention_tpu.meta import make_attn_meta_from_dispatch_meta
+
+    qr, kr, tm = _sliding()
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr), tm,
+        S, S, CHUNK, CP,
+    )
+    est = sum(estimate_remote_rows_per_rank(bucket, mq.partitions))
+    cmm, _ = make_attn_meta_from_dispatch_meta(bucket, mq)
+    planned = sum(a.payload_rows() for a in cmm.kv_stages)
+    assert est == planned
+
+
+def test_auto_through_make_dispatch_meta_deterministic():
+    qr, kr, tm = _sliding()
+    rq = AttnRanges.from_ranges(qr)
+    rk = AttnRanges.from_ranges(kr)
+    p1 = make_dispatch_meta_from_qk_ranges(
+        rq, rk, tm, S, S, CHUNK, CP, dispatch_config=CFG
+    )[0].partitions
+    p2 = make_dispatch_meta_from_qk_ranges(
+        rq, rk, tm, S, S, CHUNK, CP, dispatch_config=CFG
+    )[0].partitions
+    assert p1 == p2
+
+
+def test_auto_cross_attention_uses_kv_ownership():
+    """Cross-attn AUTO must score against sequential kv shards, not the
+    rank's q ranges (a k-space vs q-space category error otherwise)."""
+    sk = S * 4
+    mq, mkv, bucket = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges([[0, S]]),
+        AttnRanges.from_ranges([[0, sk]]),
+        [AttnMaskType.FULL],
+        S, sk, CHUNK, CP, dispatch_config=CFG,
+    )
+    # kv meta stays the sequential even shard
+    assert mkv.partitions == [[r] for r in range(CP)]
+    # every rank needs all sk rows minus its own shard
+    own = sk // CP
+    est = estimate_remote_rows_per_rank(
+        bucket, mq.partitions,
+        kv_own_ranges=[
+            AttnRanges.from_ranges([[r * own, (r + 1) * own]])
+            for r in range(CP)
+        ],
+    )
+    assert est == [sk - own] * CP
+
+
+def test_auto_end_to_end_numeric():
+    """AUTO must be a drop-in: full CP pipeline matches the dense ref."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from magiattention_tpu import DistAttnConfig
+    from magiattention_tpu.api import (
+        calc_attn,
+        dispatch,
+        magi_attn_flex_key,
+        undispatch,
+    )
+    from magiattention_tpu.common.mask import AttnMask
+    from magiattention_tpu.testing import assert_close, ref_attn
+
+    s, h, hk, d, chunk, cp = 256, 2, 1, 32, 16, 4
+    qr = [[0, 64], [64, s]]
+    kr = [[0, 64], [0, s]]
+    tm = [1, 3]  # sliding-window-ish: causal head + bicausal band
+    mesh = Mesh(np.array(jax.devices("cpu")[:cp]), axis_names=("cp",))
+    key = magi_attn_flex_key(
+        qr, kr, tm, s, s, mesh=mesh, cp_axis="cp", chunk_size=chunk,
+        dist_attn_config=DistAttnConfig(dispatch_config=CFG),
+    )
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((s, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((s, hk, d)), jnp.float32)
+
+    def fwd(q, k, v):
+        q_d = dispatch(q, key)
+        k_d = dispatch(k, key, role="kv")
+        v_d = dispatch(v, key, role="kv")
+        out_d, _ = calc_attn(q_d, k_d, v_d, key)
+        return undispatch(out_d, key)
+
+    out = jax.jit(fwd)(q, k, v)
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr),
+        AttnRanges.from_ranges(kr),
+        [AttnMaskType.from_int_type(t) for t in tm],
+        total_seqlen_q=s,
+        total_seqlen_k=s,
+    ).mask_array
+    out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
+                 msg="auto dispatch e2e out")
