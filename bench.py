@@ -46,15 +46,20 @@ def run_worker() -> int:
 
     import jax
 
-    try:
+    if os.environ.get("MAGI_BENCH_FORCE_CPU") != "1":
         # reuse Mosaic executables compiled in earlier runs/windows — first
         # compile is 20-40s per kernel variant, which a flaky chip window
-        # may not have
-        from magiattention_tpu.utils.compile_cache import enable_persistent_cache
+        # may not have. TPU path only: reloading CPU AOT cache entries can
+        # SIGILL on machine-feature mismatch, and the degraded path must
+        # never crash.
+        try:
+            from magiattention_tpu.utils.compile_cache import (
+                enable_persistent_cache,
+            )
 
-        enable_persistent_cache()
-    except Exception:
-        pass
+            enable_persistent_cache()
+        except Exception:
+            pass
 
     if os.environ.get("MAGI_BENCH_FORCE_CPU") == "1":
         # the axon sitecustomize force-sets JAX_PLATFORMS=axon, overriding

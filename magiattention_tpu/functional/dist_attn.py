@@ -121,11 +121,7 @@ def _multi_ffa_bwd(params_list, res, cts):
         dk_t, dv_t = _ffa_bwd_dkv_pallas(
             prm, *arrs[3:6], q_t, k_t, v_t, do_t, lse_t, delta_t
         )
-        g = prm.group
-        if g > 1:
-            hq, skp_, dh = dk_t.shape
-            dk_t = dk_t.reshape(hq // g, g, skp_, dh).sum(axis=1)
-            dv_t = dv_t.reshape(hq // g, g, skp_, dv_t.shape[-1]).sum(axis=1)
+        # dk/dv already per kv head (dkv kernel sums the GQA group)
         dq = dq_t.transpose(1, 0, 2)[:sq].astype(q.dtype)
         dq_total = dq if dq_total is None else dq_total + dq
         dks.append(dk_t.transpose(1, 0, 2)[: k.shape[0]].astype(k.dtype))

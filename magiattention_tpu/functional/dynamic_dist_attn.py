@@ -145,11 +145,7 @@ def _dyn_bwd(static, axis, res, cts):
     dk_t, dv_t = _ffa_bwd_dkv_pallas(
         params, *arrays[3:6], q_t, k_t, v_t, do_t, lse_t, delta_t
     )
-    g = params.group
-    if g > 1:
-        hq, skp_, dh = dk_t.shape
-        dk_t = dk_t.reshape(hq // g, g, skp_, dh).sum(axis=1)
-        dv_t = dv_t.reshape(hq // g, g, skp_, dv_t.shape[-1]).sum(axis=1)
+    # dk/dv already per kv head (dkv kernel sums the GQA group)
 
     dq_buf = dq_t.transpose(1, 0, 2)[:nbuf]
     dk_buf = dk_t.transpose(1, 0, 2)[: k_buf.shape[0]]

@@ -542,15 +542,22 @@ def _bwd_dkv_kernel(
     softcap: float,
     bq: int,
     bk: int,
+    group: int,
 ):
+    # grid (hk, W, gi): the GQA group dim is innermost so dk/dv accumulate
+    # over the g q-heads of a kv head in VMEM scratch — the kv-head output
+    # is written once (vs per-q-head partials + a host reshape-sum, which
+    # costs g x the HBM writes; the CUDA kernel accumulates in-epilogue the
+    # same way). k/v blocks stay resident across the g inner steps.
     w = pl.program_id(1)
+    gi = pl.program_id(2)
     is_first = meta_ref[w, IS_FIRST]
     is_last = meta_ref[w, IS_LAST]
     is_full = meta_ref[w, IS_FULL]
     use_exp2 = softcap == 0.0
     exp_fn = jnp.exp2 if use_exp2 else jnp.exp
 
-    @pl.when(is_first == 1)
+    @pl.when((is_first == 1) & (gi == 0))
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -618,7 +625,7 @@ def _bwd_dkv_kernel(
             masked=True,
         )
 
-    @pl.when(is_last == 1)
+    @pl.when((is_last == 1) & (gi == group - 1))
     def _():
         dk_ref[0] = dk_scr[:]
         dv_ref[0] = dv_scr[:]
@@ -640,34 +647,51 @@ def _ffa_bwd_dkv_pallas(
     q_scale = params.softmax_scale * (LOG2E if use_exp2 else 1.0)
     q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
 
+    # grid (hk, WT, g): group innermost so the kv-head dk/dv accumulate in
+    # scratch over the g q-heads (outputs and k/v fetches are per kv head —
+    # 1/g the HBM traffic of per-q-head partials)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(hq, WT),
+        grid=(hk, WT, g),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda h, w, qt, kt, mt: (h, qt[w], 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h // g, kt[w], 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h // g, kt[w], 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, dv), lambda h, w, qt, kt, mt: (h, qt[w], 0),
-                         memory_space=pltpu.VMEM),
             pl.BlockSpec(
-                (None, NUM_SUBLANES, bq),
-                lambda h, w, qt, kt, mt: (h, 0, qt[w]),
+                (1, bq, d),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, w, gi, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, dv), lambda h, w, gi, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bq, dv),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, qt[w], 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
                 (None, NUM_SUBLANES, bq),
-                lambda h, w, qt, kt, mt: (h, 0, qt[w]),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, 0, qt[w]),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, NUM_SUBLANES, bq),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, 0, qt[w]),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h, kt[w], 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, w, gi, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, dv), lambda h, w, gi, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -676,18 +700,18 @@ def _ffa_bwd_dkv_pallas(
     )
     kernel = partial(
         _bwd_dkv_kernel, softcap=params.softcap,
-        bq=bq, bk=bk,
+        bq=bq, bk=bk, group=g,
     )
     dk_t, dv_t = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((hq, skp, d), jnp.float32),
-            jax.ShapeDtypeStruct((hq, skp, dv), jnp.float32),
+            jax.ShapeDtypeStruct((hk, skp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hk, skp, dv), jnp.float32),
         ],
         interpret=params.interpret,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
     )(work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
       _lanes_layout(_clamp_lse(lse_t), NUM_SUBLANES),
@@ -739,11 +763,8 @@ def _ffa_core_bwd(params: FFAParams, res, cts):
         params, work_qt_t, work_kt_t, meta_t,
         q_t, k_t, v_t, do_t, lse_t, delta_t,
     )
-    g = params.group
-    if g > 1:
-        hq, skp, d = dk_t.shape
-        dk_t = dk_t.reshape(hq // g, g, skp, d).sum(axis=1)
-        dv_t = dv_t.reshape(hq // g, g, skp, dv_t.shape[-1]).sum(axis=1)
+    # dk/dv already come back per kv head: the dkv kernel accumulates the
+    # GQA group in-kernel (no host reshape-sum)
     return (
         dq_t.astype(q_t.dtype),
         dk_t.astype(k_t.dtype),

@@ -1,5 +1,8 @@
 """Model layer: reference integrations live in examples/ for the reference
 (Llama-3 + FSDP/Megatron/Transformers, ref examples/); here the flagship
-model is a JAX-native Llama with CP attention built in."""
+models are JAX-native with CP attention built in — a Llama decoder and a
+Magi-1-style video diffusion transformer (the reference's headline
+workload, ref README.md:54-56)."""
 
 from .llama import LlamaConfig, forward, init_params, train_step  # noqa: F401
+from . import video_dit  # noqa: F401

@@ -1,0 +1,350 @@
+"""Video DiT: a diffusion-transformer model family on spatiotemporal CP.
+
+The reference's flagship workload is Magi-1 — an autoregressive video
+diffusion transformer trained with MagiAttention's varlen-block-causal mask
+at 131k context (ref README.md:54-56; the Magi-1 mask is bench config 4 in
+docs/source/blog/cp_benchmark.md:82-96). This module is the TPU-native
+counterpart of that model family: a compact DiT (AdaLN conditioning on the
+diffusion timestep, flow-matching objective) whose attention runs through
+``magi_attn_flex_key -> dispatch -> calc_attn`` over the spatiotemporal
+block mask (frames causal, each frame attending the last ``window_frames``
+frames — utils/sparse_utils.make_video_block_mask).
+
+Layout mirrors models/llama.py: packed tokens (no batch dim), every
+non-attention op row-wise or a matmul so the whole network computes on the
+dispatched (chunk-permuted, cp-sharded) layout; factorized (frame, spatial)
+position embeddings are gathered with the dispatched global position ids.
+Projection weights reuse llama's names so ``llama.shard_params`` (ZeRO-3 +
+optional Megatron TP) applies unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import calc_attn, dispatch, get_position_ids, magi_attn_flex_key
+from ..dist_attn_runtime_mgr import DistAttnRuntimeKey
+from ..utils.sparse_utils import (
+    block_mask_to_dense_mask,
+    block_mask_to_ranges,
+    make_video_block_mask,
+)
+from .llama import _rms_norm, shard_params  # noqa: F401  (re-exported)
+
+
+@dataclass(frozen=True)
+class VideoDiTConfig:
+    num_frames: int = 8
+    tokens_per_frame: int = 256
+    in_dim: int = 16  # latent channels per token
+    dim: int = 384
+    n_layers: int = 4
+    n_heads: int = 6
+    n_kv_heads: int = 6
+    head_dim: int = 64
+    ffn_hidden: int = 1024
+    window_frames: int = 2  # each frame sees this many trailing frames
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = False
+
+    @property
+    def seqlen(self) -> int:
+        return self.num_frames * self.tokens_per_frame
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def video_mask_ranges(cfg: VideoDiTConfig):
+    """(q_ranges, k_ranges, attn_type_map, block_mask) of the Magi-1-style
+    spatiotemporal mask at frame-block granularity."""
+    bm = make_video_block_mask(cfg.num_frames, 1, cfg.window_frames)
+    qr, kr, tm = block_mask_to_ranges(
+        bm, cfg.tokens_per_frame, cfg.tokens_per_frame
+    )
+    return (
+        [[r.start, r.end] for r in qr],
+        [[r.start, r.end] for r in kr],
+        [t.to_int_type() for t in tm],
+        bm,
+    )
+
+
+def make_video_attn_key(
+    cfg: VideoDiTConfig,
+    mesh,
+    cp_axis: str = "cp",
+    chunk_size: int | None = None,
+    dist_attn_config=None,
+) -> DistAttnRuntimeKey:
+    qr, kr, tm, _ = video_mask_ranges(cfg)
+    kwargs = {}
+    if dist_attn_config is not None:
+        kwargs["dist_attn_config"] = dist_attn_config
+    return magi_attn_flex_key(
+        qr, kr, tm, cfg.seqlen, cfg.seqlen,
+        mesh=mesh, cp_axis=cp_axis,
+        chunk_size=chunk_size or cfg.tokens_per_frame // 2,
+        **kwargs,
+    )
+
+
+def dense_video_mask(cfg: VideoDiTConfig) -> np.ndarray:
+    """Token-level boolean oracle for the dense twin."""
+    _, _, _, bm = video_mask_ranges(cfg)
+    return block_mask_to_dense_mask(
+        bm, cfg.tokens_per_frame, cfg.tokens_per_frame
+    )
+
+
+def init_params(cfg: VideoDiTConfig, key: jax.Array) -> dict:
+    ks = jax.random.split(key, 6 + cfg.n_layers)
+    dim, dh = cfg.dim, cfg.head_dim
+    hq, hk = cfg.n_heads, cfg.n_kv_heads
+
+    def dense(k, shape, scale=None):
+        return jax.random.normal(k, shape, dtype=jnp.float32) * (
+            (scale if scale is not None else shape[0] ** -0.5)
+        )
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(ks[6 + i], 8)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((dim,), jnp.float32),
+                "wq": dense(lk[0], (dim, hq * dh)),
+                "wk": dense(lk[1], (dim, hk * dh)),
+                "wv": dense(lk[2], (dim, hk * dh)),
+                "wo": dense(lk[3], (hq * dh, dim)),
+                "mlp_norm": jnp.ones((dim,), jnp.float32),
+                "w_gate": dense(lk[4], (dim, cfg.ffn_hidden)),
+                "w_up": dense(lk[5], (dim, cfg.ffn_hidden)),
+                "w_down": dense(lk[6], (cfg.ffn_hidden, dim)),
+                # AdaLN modulation: cond -> (shift,scale,gate) x (attn,mlp).
+                # Small init keeps the network near-identity at t=0 while
+                # still passing gradient everywhere (DiT's adaLN-Zero uses
+                # exact zeros; small-random keeps parity tests meaningful).
+                "w_mod": dense(lk[7], (dim, 6 * dim), scale=1e-3),
+                "b_mod": jnp.zeros((6 * dim,), jnp.float32),
+            }
+        )
+    return {
+        "w_in": dense(ks[0], (cfg.in_dim, dim)),
+        "frame_emb": dense(ks[1], (cfg.num_frames, dim), scale=0.02),
+        "spatial_emb": dense(ks[2], (cfg.tokens_per_frame, dim), scale=0.02),
+        # timestep conditioning MLP (sinusoidal -> dim -> dim)
+        "w_t1": dense(ks[3], (dim, dim)),
+        "w_t2": dense(ks[4], (dim, dim)),
+        "final_norm": jnp.ones((dim,), jnp.float32),
+        # small (not exactly zero, as DiT does) so gradients reach the body
+        # from step 0 and the CP-vs-dense parity check is meaningful
+        "w_out": dense(ks[5], (cfg.dim, cfg.in_dim), scale=1e-3),
+        "layers": layers,
+    }
+
+
+def _timestep_embedding(t: jax.Array, dim: int) -> jax.Array:
+    """Sinusoidal embedding of the diffusion time ``t`` in [0, 1]."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -np.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    ang = t.astype(jnp.float32) * 1000.0 * freqs
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)])
+
+
+def _modulate(h, mod, dt):
+    shift, scale, gate = mod
+    return h * (1.0 + scale.astype(dt)) + shift.astype(dt), gate
+
+
+def forward(
+    params: dict,
+    cfg: VideoDiTConfig,
+    latents: jax.Array,
+    t: jax.Array,
+    attn_key: DistAttnRuntimeKey,
+) -> jax.Array:
+    """Velocity prediction on the dispatched layout.
+
+    Args:
+        latents: ``(seqlen, in_dim)`` noisy video latents, natural order.
+        t: scalar diffusion time in [0, 1].
+
+    Returns:
+        ``(shard, in_dim)`` prediction in DISPATCHED order (dispatch the
+        flow-matching target with the same key — cheaper than undispatch).
+    """
+    dt = cfg.jdtype
+    x = (latents.astype(dt) @ params["w_in"].astype(dt))
+    x = dispatch(x, attn_key)
+    pos = get_position_ids(attn_key)
+    frame = pos // cfg.tokens_per_frame
+    sp = pos % cfg.tokens_per_frame
+    x = x + (
+        jnp.take(params["frame_emb"], frame, axis=0)
+        + jnp.take(params["spatial_emb"], sp, axis=0)
+    ).astype(dt)
+
+    cond = _timestep_embedding(t, cfg.dim)
+    cond = jax.nn.silu(cond @ params["w_t1"])
+    cond = jax.nn.silu(cond @ params["w_t2"])  # (dim,) fp32
+
+    def layer(x, lyr):
+        mods = (cond @ lyr["w_mod"] + lyr["b_mod"]).reshape(6, cfg.dim)
+        h = _rms_norm(x, lyr["attn_norm"], cfg.norm_eps)
+        h, gate_a = _modulate(h, mods[0:3], dt)
+        q = (h @ lyr["wq"].astype(dt)).reshape(-1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lyr["wk"].astype(dt)).reshape(
+            -1, cfg.n_kv_heads, cfg.head_dim
+        )
+        v = (h @ lyr["wv"].astype(dt)).reshape(
+            -1, cfg.n_kv_heads, cfg.head_dim
+        )
+        attn_out, _ = calc_attn(q, k, v, attn_key)
+        attn_out = attn_out.reshape(-1, cfg.n_heads * cfg.head_dim)
+        x = x + gate_a.astype(dt) * (attn_out @ lyr["wo"].astype(dt))
+
+        h = _rms_norm(x, lyr["mlp_norm"], cfg.norm_eps)
+        h, gate_m = _modulate(h, mods[3:6], dt)
+        up = jax.nn.silu(h @ lyr["w_gate"].astype(dt)) * (
+            h @ lyr["w_up"].astype(dt)
+        )
+        return x + gate_m.astype(dt) * (up @ lyr["w_down"].astype(dt))
+
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+
+    for lyr in params["layers"]:
+        x = layer(x, lyr)
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x.astype(jnp.float32) @ params["w_out"])
+
+
+def loss_fn(
+    params: dict,
+    cfg: VideoDiTConfig,
+    clean: jax.Array,
+    noise: jax.Array,
+    t: jax.Array,
+    attn_key: DistAttnRuntimeKey,
+) -> jax.Array:
+    """Flow-matching MSE: x_t = (1-t)·x0 + t·eps, target v = eps - x0.
+
+    The prediction comes back in dispatched order; the target is dispatched
+    with the same permutation (mirrors llama.loss_fn's label handling).
+    """
+    xt = (1.0 - t) * clean + t * noise
+    pred = forward(params, cfg, xt, t, attn_key)
+    target = dispatch((noise - clean).astype(jnp.float32), attn_key)
+    return jnp.mean((pred - target) ** 2)
+
+
+# ---------------------------------------------------------------------------
+# dense (non-CP) twin — convergence-parity artifact, mirrors llama.py
+# ---------------------------------------------------------------------------
+
+
+def forward_dense(
+    params: dict,
+    cfg: VideoDiTConfig,
+    latents: jax.Array,
+    t: jax.Array,
+    mask: jax.Array,
+) -> jax.Array:
+    dt = cfg.jdtype
+    s = latents.shape[0]
+    x = latents.astype(dt) @ params["w_in"].astype(dt)
+    pos = jnp.arange(s, dtype=jnp.int32)
+    frame = pos // cfg.tokens_per_frame
+    sp = pos % cfg.tokens_per_frame
+    x = x + (
+        jnp.take(params["frame_emb"], frame, axis=0)
+        + jnp.take(params["spatial_emb"], sp, axis=0)
+    ).astype(dt)
+
+    cond = _timestep_embedding(t, cfg.dim)
+    cond = jax.nn.silu(cond @ params["w_t1"])
+    cond = jax.nn.silu(cond @ params["w_t2"])
+
+    g = cfg.n_heads // cfg.n_kv_heads
+    for lyr in params["layers"]:
+        mods = (cond @ lyr["w_mod"] + lyr["b_mod"]).reshape(6, cfg.dim)
+        h = _rms_norm(x, lyr["attn_norm"], cfg.norm_eps)
+        h, gate_a = _modulate(h, mods[0:3], dt)
+        q = (h @ lyr["wq"].astype(dt)).reshape(-1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lyr["wk"].astype(dt)).reshape(
+            -1, cfg.n_kv_heads, cfg.head_dim
+        )
+        v = (h @ lyr["wv"].astype(dt)).reshape(
+            -1, cfg.n_kv_heads, cfg.head_dim
+        )
+        kf = jnp.repeat(k, g, axis=1)
+        vf = jnp.repeat(v, g, axis=1)
+        logits = jnp.einsum(
+            "shd,thd->hst", q.astype(jnp.float32), kf.astype(jnp.float32)
+        ) * (cfg.head_dim ** -0.5)
+        logits = jnp.where(mask[None], logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        attn_out = jnp.einsum("hst,thd->shd", p, vf.astype(jnp.float32))
+        attn_out = attn_out.astype(dt).reshape(
+            -1, cfg.n_heads * cfg.head_dim
+        )
+        x = x + gate_a.astype(dt) * (attn_out @ lyr["wo"].astype(dt))
+
+        h = _rms_norm(x, lyr["mlp_norm"], cfg.norm_eps)
+        h, gate_m = _modulate(h, mods[3:6], dt)
+        up = jax.nn.silu(h @ lyr["w_gate"].astype(dt)) * (
+            h @ lyr["w_up"].astype(dt)
+        )
+        x = x + gate_m.astype(dt) * (up @ lyr["w_down"].astype(dt))
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x.astype(jnp.float32) @ params["w_out"]
+
+
+def loss_fn_dense(params, cfg, clean, noise, t, mask):
+    xt = (1.0 - t) * clean + t * noise
+    pred = forward_dense(params, cfg, xt, t, mask)
+    return jnp.mean((pred - (noise - clean).astype(jnp.float32)) ** 2)
+
+
+def make_optax_train_step(cfg: VideoDiTConfig, attn_key, optimizer):
+    import optax
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, clean, noise, t):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, cfg, clean, noise, t, attn_key
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_optax_train_step_dense(cfg: VideoDiTConfig, mask, optimizer):
+    import optax
+
+    mask = jnp.asarray(mask)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, clean, noise, t):
+        loss, grads = jax.value_and_grad(loss_fn_dense)(
+            params, cfg, clean, noise, t, mask
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
