@@ -8,6 +8,7 @@ operations. Pure Python, no JAX dependency.
 
 from __future__ import annotations
 
+import bisect
 from typing import Any, Iterable, Iterator, Sequence
 
 import numpy as np
@@ -264,6 +265,17 @@ class AttnRanges:
             chunks.append(cur)
         return chunks
 
+    def locator(self) -> "RangeLocator":
+        """Bisect-backed global<->local mapper over the merged ranges.
+
+        Build once per (stable) range list and reuse: every query is
+        O(log n + pieces) instead of make_ranges_local's O(n) scan with a
+        fresh merge — the 1M-token planning hot path (the reference solves
+        the same problem by moving these loops into the C++ backend,
+        csrc/extensions/attn_ranges.hpp).
+        """
+        return RangeLocator(self)
+
     def make_range_local(self, r: AttnRange, is_self_merged: bool = False) -> AttnRange:
         """Map a global sub-range into the local (concatenated) coordinate system
         defined by this range list. ``r`` must be fully inside one range."""
@@ -353,4 +365,68 @@ class AttnRanges:
         out: list[int] = []
         for r in self._ranges:
             out.extend(range(r.start, r.end))
+        return out
+
+
+class RangeLocator:
+    """Bisect-backed global->local mapper for a merged range list.
+
+    Precomputes (starts, ends, local offsets) of the merged host ranges so
+    repeated single-range queries avoid make_ranges_local's per-call merge +
+    linear scan (the 1M-token planning hot loop; the reference keeps these
+    loops in C++, csrc/extensions/attn_ranges.hpp).
+    """
+
+    __slots__ = ("starts", "ends", "offsets")
+
+    def __init__(self, host: "AttnRanges") -> None:
+        merged = host.merge()
+        self.starts = [r.start for r in merged]
+        self.ends = [r.end for r in merged]
+        self.offsets = []
+        off = 0
+        for r in merged:
+            self.offsets.append(off)
+            off += r.seqlen
+
+    def segments(
+        self, start: int, end: int
+    ) -> list[tuple[int, int, int | None]]:
+        """Decompose global [start, end) into maximal pieces.
+
+        Returns (gs, ge, local_start) per piece in global order;
+        ``local_start`` is None for pieces not covered by the host ranges
+        (holes). Empty input yields [].
+        """
+        out: list[tuple[int, int, int | None]] = []
+        if start >= end:
+            return out
+        pos = start
+        # first host range whose end > pos
+        i = bisect.bisect_right(self.ends, pos)
+        n = len(self.starts)
+        while pos < end:
+            if i >= n or self.starts[i] >= end:
+                out.append((pos, end, None))
+                break
+            hs, he = self.starts[i], self.ends[i]
+            if pos < hs:
+                out.append((pos, hs, None))
+                pos = hs
+            ge = min(end, he)
+            out.append((pos, ge, self.offsets[i] + (pos - hs)))
+            pos = ge
+            i += 1
+        return out
+
+    def to_local(self, start: int, end: int) -> list[tuple[int, int]]:
+        """Local (ls, le) pieces covering global [start, end); raises
+        RangeError on any uncovered position (make_ranges_local contract)."""
+        out = []
+        for gs, ge, ls in self.segments(start, end):
+            if ls is None:
+                raise RangeError(
+                    f"range [{start}, {end}) not fully covered by host"
+                )
+            out.append((ls, ls + (ge - gs)))
         return out

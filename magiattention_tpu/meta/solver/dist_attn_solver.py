@@ -28,7 +28,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ...common.range import AttnRange
+from ...common.range import AttnRange, RangeError
 from ...common.ranges import AttnRanges
 from ...config import OverlapConfig
 from ...kernels.mask_utils import BAND_INF
@@ -140,6 +140,15 @@ class DistAttnSolver:
 
         chunks_by_id = {c.chunk_id: c for c in self.bucket.q_chunks}
         self._owner_map = _OwnerMap(kv_ranges)
+        # bisect locators, built once per rank: the per-slice global->local
+        # remaps below were the 1M-token planning hot loop (O(n) scans +
+        # re-merges inside make_ranges_local)
+        self._kv_locators = [kv.locator() for kv in kv_ranges]
+        own_locators = (
+            self._kv_locators
+            if host_ranges is kv_ranges
+            else [h.locator() for h in host_ranges]
+        )
 
         # ---- pass 1: per rank, split slice coverage into host/remote -----
         # host slice tuples per rank: (qs,qe,ks,ke,lo,hi) local coords
@@ -155,12 +164,11 @@ class DistAttnSolver:
         ]
 
         for r in range(cp):
-            own = host_ranges[r]
             for chunk_id in meta.partitions[r]:
                 chunk = chunks_by_id[chunk_id]
                 for s in chunk.attn_slices:
                     self._split_slice(
-                        s, r, own, kv_ranges,
+                        s, r, own_locators[r], self._kv_locators[r],
                         host_slices[r], deferred[r], requests[r],
                     )
 
@@ -253,7 +261,7 @@ class DistAttnSolver:
         for st in range(degree):
             kv_stages.append(
                 self._make_group_collective_arg(
-                    intervals, kv_ranges, st, stage_recv_len[st]
+                    intervals, st, stage_recv_len[st]
                 )
             )
 
@@ -299,51 +307,55 @@ class DistAttnSolver:
         self,
         s: AttnSlice,
         rank: int,
-        own: AttnRanges,
-        kv_ranges: list[AttnRanges],
+        own_locator,
+        kv_locator,
         host_out: list[tuple[int, ...]],
         deferred_out: list[tuple[AttnRange, AttnRange, int, int, int]],
         requests_out: list[AttnRanges],
     ) -> None:
         """Split one owned (chunk-clipped) slice into host/remote pieces.
 
-        ``own`` gives q-locality (this rank's q rows); ``kv_ranges`` gives kv
-        ownership per rank (== q ownership for self-attn, separate dispatch
-        for cross-attn).
+        ``own_locator`` maps this rank's q rows global->local; ``kv_locator``
+        maps its kv ownership (== q ownership for self-attn, separate
+        dispatch for cross-attn). One locator ``segments`` sweep replaces
+        the find_overlap/find_hole/make_ranges_local scans.
         """
         shrunk = s.shrink()
         if shrunk.q_range.is_empty():
             return
         q_glob = shrunk.q_range
-        q_loc = own.make_range_local(q_glob)
+        q_pieces = own_locator.to_local(q_glob.start, q_glob.end)
+        if len(q_pieces) != 1:
+            raise RangeError(
+                f"q range {q_glob} spans multiple host pieces"
+            )
+        q_loc = AttnRange(*q_pieces[0])
         qoff = q_glob.start - q_loc.start
         needed_k = shrunk.needed_k_range()
         if needed_k.is_empty():
             return
-        needed = AttnRanges([needed_k])
         lo, hi = shrunk.d_lo, shrunk.d_hi
-        kv_own = kv_ranges[rank]
 
-        # local parts
-        for part in needed.find_overlap_ranges(kv_own):
-            for k_loc in kv_own.make_ranges_local(AttnRanges([part])):
-                # recover the global start of this contiguous local piece
-                k_glob_start = _local_to_global(kv_own, k_loc.start)
-                koff = k_glob_start - k_loc.start
+        for gs, ge, lstart in kv_locator.segments(
+            needed_k.start, needed_k.end
+        ):
+            if lstart is not None:
+                # local part: band offsets shift into local coords
+                koff = gs - lstart
                 lo_l = lo if lo <= -BAND_INF else lo + qoff - koff
                 hi_l = hi if hi >= BAND_INF else hi + qoff - koff
                 host_out.append(
-                    (q_loc.start, q_loc.end, k_loc.start, k_loc.end, lo_l, hi_l)
+                    (q_loc.start, q_loc.end, lstart, lstart + (ge - gs),
+                     lo_l, hi_l)
                 )
-
-        # remote parts, split by owner (O(log n) owner-map bisect)
-        for hole in needed.find_hole_ranges(kv_own):
-            for ps, pe, src in self._owner_map.split(hole.start, hole.end):
-                if src == rank:
-                    continue
-                part = AttnRange(ps, pe)
-                requests_out[src].append(part)
-                deferred_out.append((q_loc, part, lo, hi, qoff))
+            else:
+                # remote hole, split by owner (O(log n) owner-map bisect)
+                for ps, pe, src in self._owner_map.split(gs, ge):
+                    if src == rank:
+                        continue
+                    part = AttnRange(ps, pe)
+                    requests_out[src].append(part)
+                    deferred_out.append((q_loc, part, lo, hi, qoff))
 
     def _assign_stages(
         self, intervals: list[list[_RemoteInterval]], degree: int
@@ -368,7 +380,6 @@ class DistAttnSolver:
     def _make_group_collective_arg(
         self,
         intervals: list[list[_RemoteInterval]],
-        host_ranges: list[AttnRanges],
         stage: int,
         recv_len_padded: int,
     ) -> GroupCollectiveArg:
@@ -392,16 +403,16 @@ class DistAttnSolver:
                 key=lambda iv: iv.offset,
             ):
                 transfer_table[dst][iv.src].append(iv.grange)
-                local_rows = host_ranges[iv.src].make_ranges_local(
-                    AttnRanges([iv.grange])
+                local_rows = self._kv_locators[iv.src].to_local(
+                    iv.grange.start, iv.grange.end
                 )
                 start_pos = int(pair_count[iv.src, dst])
                 n = 0
-                for lr in local_rows:
+                for ls, le in local_rows:
                     send_chunks[iv.src][dst].append(
-                        np.arange(lr.start, lr.end, dtype=np.int32)
+                        np.arange(ls, le, dtype=np.int32)
                     )
-                    n += lr.seqlen
+                    n += le - ls
                 pair_count[iv.src, dst] += n
                 recv_parts[dst].append((iv.src, start_pos, n))
 
@@ -462,14 +473,6 @@ class DistAttnSolver:
             arg.lowering = "ppermute"
         return arg
 
-
-def _local_to_global(own: AttnRanges, local_pos: int) -> int:
-    off = 0
-    for r in own:
-        if local_pos < off + r.seqlen:
-            return r.start + (local_pos - off)
-        off += r.seqlen
-    raise ValueError(f"local position {local_pos} out of range")
 
 
 def _find_interval(

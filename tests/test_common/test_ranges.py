@@ -124,3 +124,57 @@ class TestAttnRanges:
         assert arr.shape == (2, 2)
         assert arr.dtype.name == "int32"
         assert arr.tolist() == [[0, 4], [8, 12]]
+
+
+class TestRangeLocator:
+    """Bisect locator must agree with make_ranges_local / hole finding."""
+
+    def _host(self):
+        from magiattention_tpu.common.ranges import AttnRanges
+
+        return AttnRanges.from_ranges([[10, 20], [30, 35], [50, 80]])
+
+    def test_to_local_matches_make_ranges_local(self):
+        from magiattention_tpu.common.range import AttnRange
+        from magiattention_tpu.common.ranges import AttnRanges
+
+        host = self._host()
+        loc = host.locator()
+        for qs, qe in [(10, 20), (12, 18), (30, 35), (15, 33), (10, 80)]:
+            try:
+                expected = host.make_ranges_local(
+                    AttnRanges([AttnRange(qs, qe)])
+                )
+                exp = [(r.start, r.end) for r in expected]
+            except Exception:
+                exp = None
+            if exp is None:
+                import pytest
+
+                with pytest.raises(Exception):
+                    loc.to_local(qs, qe)
+            else:
+                assert loc.to_local(qs, qe) == exp, (qs, qe)
+
+    def test_segments_cover_holes_and_host(self):
+        loc = self._host().locator()
+        segs = loc.segments(0, 90)
+        # pieces tile [0, 90) exactly, alternating hole/host correctly
+        assert segs[0] == (0, 10, None)
+        assert segs[1] == (10, 20, 0)
+        assert segs[2] == (20, 30, None)
+        assert segs[3] == (30, 35, 10)
+        assert segs[4] == (35, 50, None)
+        assert segs[5] == (50, 80, 15)
+        assert segs[6] == (80, 90, None)
+        assert sum(ge - gs for gs, ge, _ in segs) == 90
+
+    def test_empty_and_unmerged_host(self):
+        from magiattention_tpu.common.ranges import AttnRanges
+
+        # unmerged/overlapping input must behave as its merged form
+        host = AttnRanges.from_ranges([[5, 10], [8, 15], [0, 2]])
+        loc = host.locator()
+        assert loc.to_local(5, 15) == [(2, 12)]
+        assert loc.segments(3, 4) == [(3, 4, None)]
+        assert loc.segments(7, 7) == []

@@ -19,8 +19,9 @@ from magiattention_tpu.meta import (
     make_dispatch_meta_from_qk_ranges,
 )
 
-# generous CI budget: observed ~8s on an idle dev box (was 114s before the
-# owner-map/interval-index/vectorization pass)
+# generous CI budget: observed ~4s on an idle dev box (114s -> 8s via the
+# owner-map/interval-index/vectorization pass, -> ~4s via RangeLocator
+# bisect remaps replacing make_ranges_local scans)
 BUDGET_S = 40.0
 
 
