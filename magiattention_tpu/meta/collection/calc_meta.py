@@ -43,8 +43,9 @@ class AttnArg:
         total_seqlen_q: int,
         total_seqlen_k: int,
     ) -> "AttnArg":
-        """slices: list of (qs, qe, ks, ke, d_lo, d_hi) in local coords."""
-        if not slices:
+        """slices: (n, 6) rows of (qs, qe, ks, ke, d_lo, d_hi) in local
+        coords — a list of tuples or an int array."""
+        if len(slices) == 0:
             return cls.empty(total_seqlen_q, total_seqlen_k)
         arr = np.asarray(slices, dtype=np.int64)
         return cls(

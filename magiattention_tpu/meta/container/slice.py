@@ -30,6 +30,34 @@ def band_area(
     return int(np.clip(hi_j - lo_j + 1, 0, None).sum())
 
 
+def band_area_batch(i0, i1, j0, j1, lo, hi) -> np.ndarray:
+    """Vectorized closed-form band area over int64 arrays.
+
+    Same contract as :func:`band_area` per element, O(1) per slice instead
+    of O(rows): area = f(hi) - f(lo-1) where f(d) counts rectangle pairs
+    with j - i <= d; f is a clipped arithmetic series in i.
+    """
+    i0, i1, j0, j1, lo, hi = (
+        np.asarray(a, dtype=np.int64) for a in (i0, i1, j0, j1, lo, hi)
+    )
+
+    def f(d):
+        # cnt(i) = clip(d + i + 1 - j0, 0, j1 - j0); series region is
+        # i in [j0 - d, j1 - d - 1), full region above
+        lo_i = np.clip(j0 - d, i0, i1)
+        hi_i = np.clip(j1 - d - 1, i0, i1)
+        n = hi_i - lo_i
+        first = d + lo_i + 1 - j0
+        last = d + hi_i - j0
+        series = n * (first + last) // 2
+        full = (i1 - hi_i) * (j1 - j0)
+        return series + full
+
+    area = f(hi) - f(lo - 1)
+    empty = (i0 >= i1) | (j0 >= j1) | (lo > hi)
+    return np.where(empty, 0, area)
+
+
 def _try_enable_native_band_area() -> None:
     """Swap in the closed-form native band_area when the C++ backend builds."""
     global band_area

@@ -36,7 +36,7 @@ from ..collection.calc_meta import AttnArg, CalcMeta
 from ..collection.comm_meta import CommMeta, GroupCollectiveArg
 from ..collection.dispatch_meta import DispatchMeta
 from ..container.bucket import AttnBucket
-from ..container.slice import AttnSlice, band_area
+from ..container.slice import AttnSlice, band_area_batch
 
 
 def _round_up(x: int, m: int) -> int:
@@ -85,25 +85,18 @@ class _OwnerMap:
 
 
 class _IntervalIndex:
-    """Sorted-start bisect lookup over a rank's merged remote intervals.
+    """Sorted-start view over a rank's merged remote intervals.
 
     Merged intervals are disjoint in global coords (ownership is disjoint
-    across sources), so containment lookup is a single bisect — replacing
-    the linear scans the round-1 VERDICT flagged (seconds-to-minutes at 1M
+    across sources), so a vectorized np.searchsorted over ``starts``
+    resolves every deferred piece's interval at once — replacing the
+    per-piece scans the round-1 VERDICT flagged (seconds-to-minutes at 1M
     tokens)."""
 
     def __init__(self, ivs: list[_RemoteInterval]) -> None:
         order = sorted(ivs, key=lambda iv: iv.grange.start)
         self.starts = [iv.grange.start for iv in order]
         self.ivs = order
-
-    def find(self, grange: AttnRange) -> _RemoteInterval:
-        i = bisect.bisect_right(self.starts, grange.start) - 1
-        if i >= 0:
-            iv = self.ivs[i]
-            if grange.is_subrange_of(iv.grange):
-                return iv
-        raise ValueError(f"no merged interval contains {grange}")
 
 
 class DistAttnSolver:
@@ -153,11 +146,11 @@ class DistAttnSolver:
         # ---- pass 1: per rank, split slice coverage into host/remote -----
         # host slice tuples per rank: (qs,qe,ks,ke,lo,hi) local coords
         host_slices: list[list[tuple[int, ...]]] = [[] for _ in range(cp)]
-        # deferred remote pieces per rank: (q_loc_range, k_global_range, lo,
-        # hi, qoff) — k local offset resolved after buffer layout
-        deferred: list[list[tuple[AttnRange, AttnRange, int, int, int]]] = [
-            [] for _ in range(cp)
-        ]
+        # deferred remote pieces per rank: plain-int rows
+        # (q_loc_start, q_loc_end, k_glob_start, k_glob_end, lo, hi, qoff) —
+        # k local offset resolved after buffer layout; converted to (n, 7)
+        # int64 arrays for the vectorized passes below
+        deferred: list[list[tuple[int, ...]]] = [[] for _ in range(cp)]
         # remote requests per rank per src: global ranges
         requests: list[list[AttnRanges]] = [
             [AttnRanges() for _ in range(cp)] for _ in range(cp)
@@ -174,18 +167,50 @@ class DistAttnSolver:
 
         # ---- pass 2: merge requests, stage them, lay out buffers ---------
         intervals: list[list[_RemoteInterval]] = [[] for _ in range(cp)]
+        deferred_np: list[np.ndarray] = []
+        # cached per-rank (interval index, per-piece interval id) — pass 3
+        # reuses the identical lookup
+        deferred_ii: list[tuple[_IntervalIndex, np.ndarray] | None] = []
         for r in range(cp):
             for src in range(cp):
                 for g in requests[r][src].merge():
                     intervals[r].append(_RemoteInterval(src=src, grange=g))
-            # per-interval calc cost for the overlap solver
+            # per-interval calc cost for the overlap solver — vectorized:
+            # one searchsorted containment lookup + closed-form band areas
+            # per rank (the per-piece Python loop was ~half the 1M-token
+            # planning time)
+            dm = (
+                np.asarray(deferred[r], dtype=np.int64)
+                if deferred[r]
+                else np.zeros((0, 7), dtype=np.int64)
+            )
+            deferred_np.append(dm)
+            if len(dm) == 0:
+                deferred_ii.append(None)
+                continue
             idx_r = _IntervalIndex(intervals[r])
-            for q_loc, k_glob, lo, hi, qoff in deferred[r]:
-                iv = idx_r.find(k_glob)
-                iv.area += band_area(
-                    q_loc.start + qoff, q_loc.end + qoff,
-                    k_glob.start, k_glob.end, lo, hi,
+            iv_starts = np.asarray(idx_r.starts, dtype=np.int64)
+            iv_ends = np.asarray(
+                [iv.grange.end for iv in idx_r.ivs], dtype=np.int64
+            )
+            ii = np.searchsorted(iv_starts, dm[:, 2], side="right") - 1
+            if (
+                len(iv_starts) == 0
+                or (ii < 0).any()
+                or (dm[:, 3] > iv_ends[ii]).any()
+            ):
+                raise ValueError(
+                    "deferred remote piece outside merged intervals"
                 )
+            areas = band_area_batch(
+                dm[:, 0] + dm[:, 6], dm[:, 1] + dm[:, 6],
+                dm[:, 2], dm[:, 3], dm[:, 4], dm[:, 5],
+            )
+            acc = np.zeros(len(idx_r.ivs), dtype=np.int64)
+            np.add.at(acc, ii, areas)
+            for iv, a in zip(idx_r.ivs, acc):
+                iv.area += int(a)
+            deferred_ii.append((idx_r, ii))
 
         self._assign_stages(intervals, degree)
         # dynamic mode (degree=None) may pick any degree per rank: size the
@@ -226,35 +251,61 @@ class DistAttnSolver:
         degree = len(kept)
 
         # ---- pass 3: emit remote slices in buffer-local coords -----------
-        remote_slices: list[list[list[tuple[int, ...]]]] = [
+        # per (stage, rank): (n, 6) slice rows — an int64 array for ranks
+        # with remote work, else the empty list (AttnArg.from_slices takes
+        # either)
+        remote_slices: list[list[np.ndarray | list]] = [
             [[] for _ in range(cp)] for _ in range(degree)
         ]
-        merged_slices: list[list[tuple[int, ...]]] = [list(hs) for hs in host_slices]
+        merged_slices: list[np.ndarray | list] = [
+            list(hs) for hs in host_slices
+        ]
         # merged buffer: [kv shard | stage0 | stage1 | ...]
         stage_base = [kv_shard_len]
         for st in range(1, degree):
             stage_base.append(stage_base[-1] + stage_recv_len[st - 1])
 
         for r in range(cp):
-            idx_r = _IntervalIndex(intervals[r])
-            for q_loc, k_glob, lo, hi, qoff in deferred[r]:
-                iv = idx_r.find(k_glob)
-                k_loc_start = iv.offset + (k_glob.start - iv.grange.start)
-                k_loc = (k_loc_start, k_loc_start + k_glob.seqlen)
-                koff = k_glob.start - k_loc_start
-                lo_l = lo if lo <= -BAND_INF else lo + qoff - koff
-                hi_l = hi if hi >= BAND_INF else hi + qoff - koff
-                remote_slices[iv.stage][r].append(
-                    (q_loc.start, q_loc.end, k_loc[0], k_loc[1], lo_l, hi_l)
-                )
-                mb = stage_base[iv.stage]
-                koff_m = k_glob.start - (k_loc_start + mb)
-                lo_m = lo if lo <= -BAND_INF else lo + qoff - koff_m
-                hi_m = hi if hi >= BAND_INF else hi + qoff - koff_m
-                merged_slices[r].append(
-                    (q_loc.start, q_loc.end, k_loc[0] + mb, k_loc[1] + mb,
-                     lo_m, hi_m)
-                )
+            dm = deferred_np[r]
+            if len(dm) == 0:
+                continue
+            idx_r, ii = deferred_ii[r]
+            gstart = np.asarray(
+                [iv.grange.start for iv in idx_r.ivs], dtype=np.int64
+            )[ii]
+            offset = np.asarray(
+                [iv.offset for iv in idx_r.ivs], dtype=np.int64
+            )[ii]
+            stage = np.asarray(
+                [iv.stage for iv in idx_r.ivs], dtype=np.int64
+            )[ii]
+            k0 = offset + (dm[:, 2] - gstart)
+            k1 = k0 + (dm[:, 3] - dm[:, 2])
+            koff = dm[:, 2] - k0
+            inf_lo = dm[:, 4] <= -BAND_INF
+            inf_hi = dm[:, 5] >= BAND_INF
+            lo_l = np.where(inf_lo, dm[:, 4], dm[:, 4] + dm[:, 6] - koff)
+            hi_l = np.where(inf_hi, dm[:, 5], dm[:, 5] + dm[:, 6] - koff)
+            rows_rem = np.stack(
+                [dm[:, 0], dm[:, 1], k0, k1, lo_l, hi_l], axis=1
+            )
+            mb = np.asarray(stage_base, dtype=np.int64)[stage]
+            koff_m = koff - mb
+            lo_m = np.where(inf_lo, dm[:, 4], dm[:, 4] + dm[:, 6] - koff_m)
+            hi_m = np.where(inf_hi, dm[:, 5], dm[:, 5] + dm[:, 6] - koff_m)
+            rows_mer = np.stack(
+                [dm[:, 0], dm[:, 1], k0 + mb, k1 + mb, lo_m, hi_m], axis=1
+            )
+            for st in range(degree):
+                sel = stage == st
+                if sel.any():
+                    remote_slices[st][r] = rows_rem[sel]
+            host_arr = (
+                np.asarray(host_slices[r], dtype=np.int64).reshape(-1, 6)
+                if host_slices[r]
+                else np.zeros((0, 6), dtype=np.int64)
+            )
+            merged_slices[r] = np.concatenate([host_arr, rows_mer])
 
         # ---- pass 4: comm args per stage ---------------------------------
         kv_stages = []
@@ -310,7 +361,7 @@ class DistAttnSolver:
         own_locator,
         kv_locator,
         host_out: list[tuple[int, ...]],
-        deferred_out: list[tuple[AttnRange, AttnRange, int, int, int]],
+        deferred_out: list[tuple[int, ...]],
         requests_out: list[AttnRanges],
     ) -> None:
         """Split one owned (chunk-clipped) slice into host/remote pieces.
@@ -353,9 +404,10 @@ class DistAttnSolver:
                 for ps, pe, src in self._owner_map.split(gs, ge):
                     if src == rank:
                         continue
-                    part = AttnRange(ps, pe)
-                    requests_out[src].append(part)
-                    deferred_out.append((q_loc, part, lo, hi, qoff))
+                    requests_out[src].append(AttnRange(ps, pe))
+                    deferred_out.append(
+                        (q_loc.start, q_loc.end, ps, pe, lo, hi, qoff)
+                    )
 
     def _assign_stages(
         self, intervals: list[list[_RemoteInterval]], degree: int
@@ -475,25 +527,18 @@ class DistAttnSolver:
 
 
 
-def _find_interval(
-    ivs: list[_RemoteInterval], grange: AttnRange
-) -> _RemoteInterval:
-    for iv in ivs:
-        if grange.is_subrange_of(iv.grange):
-            return iv
-    raise ValueError(f"no merged interval contains {grange}")
-
 
 def _arg_area(arg) -> int:
     """Total attention area of an AttnArg's band slices."""
-    total = 0
-    for i in range(arg.num_slices):
-        total += band_area(
-            int(arg.q_ranges[i][0]), int(arg.q_ranges[i][1]),
-            int(arg.k_ranges[i][0]), int(arg.k_ranges[i][1]),
-            int(arg.d_lo[i]), int(arg.d_hi[i]),
-        )
-    return total
+    if arg.num_slices == 0:
+        return 0
+    return int(
+        band_area_batch(
+            arg.q_ranges[:, 0], arg.q_ranges[:, 1],
+            arg.k_ranges[:, 0], arg.k_ranges[:, 1],
+            arg.d_lo, arg.d_hi,
+        ).sum()
+    )
 
 
 def _sanity_check_plan(
