@@ -49,6 +49,14 @@ from magiattention_tpu.config import DispatchConfig  # noqa: E402
 BYTES = 2  # bf16
 HK, D, DV = 8, 128, 128  # GQA kv heads; a token row of fused K|V
 ROW_BYTES = HK * (D + DV) * BYTES
+# shared with benchmarks/scaling_model.py so the two artifacts cannot drift
+PEAK_TFLOPS = 197.0  # v5e bf16 peak
+FWD_BWD_FLOP_FACTOR = 3.5  # fwd + 2.5x bwd (reference FLOP accounting)
+
+
+def chunk_for(s: int) -> int:
+    """Chunk-size policy used by every config in these reports."""
+    return max(512, s // 256)
 
 
 def magi_rows(qr, kr, tm, s, cp, chunk, alg=DispatchAlgType.MIN_HEAP):
@@ -120,7 +128,7 @@ ALGS = {
 def report(configs) -> list[dict]:
     out = []
     for name, s, cp in configs:
-        chunk = max(512, s // 256)
+        chunk = chunk_for(s)
         qr, kr, tm = config_rows(name, s, cp, chunk)
         # the dispatch algorithm controls the balance<->locality trade-off:
         # MIN_HEAP balances area ignoring locality; TOPP_HEAP tie-breaks by

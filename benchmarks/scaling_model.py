@@ -36,27 +36,26 @@ sys.path.insert(0, str(ROOT))
 sys.path.insert(0, str(ROOT / "benchmarks"))
 
 from comm_volume_report import (  # noqa: E402
-    BYTES,
     D,
-    DV,
+    FWD_BWD_FLOP_FACTOR,
     HK,
+    PEAK_TFLOPS as PEAK,
     ROW_BYTES,
+    chunk_for,
     config_rows,
     magi_rows,
 )
 
 HQ = 2 * HK  # GQA group of 2, matching the bench model shape
-PEAK = 197.0  # v5e bf16 TFLOP/s
 
 
 def project(name: str, cp: int, s_dev: int, speeds: dict[str, float],
             ici_gbps: float) -> dict:
     """speeds: label -> kernel TFLOP/s scenario."""
     s = cp * s_dev
-    chunk = max(512, s // 256)
+    chunk = chunk_for(s)
     qr, kr, tm = config_rows(name, s, cp, chunk)
 
-    from magiattention_tpu.common.mask import AttnMask  # noqa: E402
     from magiattention_tpu.common.enum import AttnMaskType  # noqa: E402
     from magiattention_tpu.common.ranges import AttnRanges  # noqa: E402
     from magiattention_tpu.meta.container.slice import (  # noqa: E402
@@ -75,12 +74,15 @@ def project(name: str, cp: int, s_dev: int, speeds: dict[str, float],
 
     from magiattention_tpu.common.enum import DispatchAlgType  # noqa: E402
 
-    # AUTO dispatch: the framework's payload-minimizing configuration
+    # AUTO dispatch: minimizes modeled max(compute, comm) rank busy-time
+    # (_make_dispatch_meta._auto_select_partitions) — it keeps the balanced
+    # scatter where compute dominates even when a lower-payload assignment
+    # exists, so some rows sit above the absolute payload floor
     _, _, _, ragged, _ = magi_rows(
         qr, kr, tm, s, cp, chunk, alg=DispatchAlgType.AUTO
     )
 
-    flops_chip = 4 * area * D * HQ * 3.5 / cp  # fwd + 2.5x bwd, per chip
+    flops_chip = 4 * area * D * HQ * FWD_BWD_FLOP_FACTOR / cp  # per chip
 
     # fwd KV cast + bwd dKV reduce (AD transpose, same volume)
     magi_bytes = 2 * ragged * ROW_BYTES / cp

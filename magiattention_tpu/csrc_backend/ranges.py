@@ -18,9 +18,8 @@ range lists and for protocol-conformance parity with the reference.
 
 from __future__ import annotations
 
-from typing import Iterable, Sequence
+from typing import Sequence
 
-import numpy as np
 
 from ..common.range import AttnRange as _PyAttnRange
 from ..common.ranges import AttnRanges as _PyAttnRanges

@@ -17,7 +17,7 @@ from typing import Any
 import jax
 from jax.sharding import Mesh
 
-from .common.enum import AttnMaskType, AttnType
+from .common.enum import AttnMaskType
 from .common.ranges import AttnRanges
 from .config import DistAttnConfig
 from .env import general as env_general

@@ -17,7 +17,6 @@ kernel's native mask primitive.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..common.enum import AttnSinkLayout

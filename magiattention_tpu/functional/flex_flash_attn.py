@@ -159,8 +159,6 @@ def _ffa_with_sink(
     q, k, v, sink, qr, kr, tmap, *, softmax_scale, softcap,
     d_lo=None, d_hi=None,
 ):
-    from functools import partial as _partial
-
     from ..kernels.ffa import (
         FFAParams,
         _should_interpret,

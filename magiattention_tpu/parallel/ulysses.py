@@ -10,7 +10,6 @@ metadata, and an inverse all_to_all restores sequence sharding. Requires
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P

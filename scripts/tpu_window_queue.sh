@@ -3,11 +3,12 @@
 #
 # Probes the tunnel TPU every 2 minutes with a short-timeout matmul; when the
 # chip responds, runs the full experiment queue (smoke -> bench -> block
-# sweep) once and exits. All compiles go through the persistent compilation
-# cache (.jax_cache) so a later window -- or the driver's round-end bench --
-# skips recompiles.
+# sweep -> profiler trace) once and exits. All compiles go through the
+# persistent compilation cache (.jax_cache) so a later window -- or the
+# driver's round-end bench -- skips recompiles.
 #
-# Logs: .tpu_logs/{queue.log,smoke.log,bench.log,probe.log}
+# Logs: .tpu_logs/{queue.log,smoke.log,bench.log,probe.log,profile.log}
+# (+ the trace protobuf under .tpu_logs/ffa_trace)
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p .tpu_logs
 LOG=.tpu_logs/queue.log
@@ -30,6 +31,9 @@ x.block_until_ready()
     echo "[$(date -u +%H:%M:%S)] bench rc=$?" >> "$LOG"
     timeout 2400 python -u scripts/tpu_perf_probe.py > .tpu_logs/probe.log 2>&1
     echo "[$(date -u +%H:%M:%S)] perf-probe rc=$?" >> "$LOG"
+    timeout 1200 python -u scripts/tpu_profile_ffa.py .tpu_logs/ffa_trace \
+      > .tpu_logs/profile.log 2>&1
+    echo "[$(date -u +%H:%M:%S)] profile rc=$?" >> "$LOG"
     echo "QUEUE DONE" >> "$LOG"
     exit 0
   fi
