@@ -49,13 +49,14 @@ def random_mask(seed: int):
     return qr, kr, tm
 
 
-def reconstruct(qr, kr, tm, cp_size, degree):
+def reconstruct(qr, kr, tm, cp_size, degree, dispatch_config=None):
     q_ranges = AttnRanges.from_ranges(qr)
     k_ranges = AttnRanges.from_ranges(kr)
     types = [AttnMaskType.from_int_type(t) for t in tm]
     config = DistAttnConfig(overlap_config=OverlapConfig(degree=degree))
     meta_q, meta_kv, bucket = make_dispatch_meta_from_qk_ranges(
-        q_ranges, k_ranges, types, S, S, CHUNK, cp_size
+        q_ranges, k_ranges, types, S, S, CHUNK, cp_size,
+        dispatch_config=dispatch_config,
     )
     comm_meta, calc_meta = make_attn_meta_from_dispatch_meta(
         bucket, meta_q, config
@@ -108,6 +109,25 @@ def test_random_mask_reconstruction(seed, cp_size, degree):
     assert mism.size == 0, (
         f"seed={seed} cp={cp_size} deg={degree}: "
         f"{len(mism)} mismatches, first={mism[:5].tolist()}"
+    )
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("cp_size", [4, 8])
+def test_random_mask_reconstruction_auto_dispatch(seed, cp_size):
+    """AUTO dispatch must preserve exact plan reconstruction on random
+    masks (whatever candidate its cost model picks)."""
+    from magiattention_tpu.common.enum import DispatchAlgType
+    from magiattention_tpu.config import DispatchConfig
+
+    qr, kr, tm = random_mask(seed)
+    recon, expected = reconstruct(
+        qr, kr, tm, cp_size, 1,
+        dispatch_config=DispatchConfig(alg=DispatchAlgType.AUTO),
+    )
+    mism = np.argwhere(recon != expected)
+    assert mism.size == 0, (
+        f"seed={seed} cp={cp_size} AUTO: {len(mism)} mismatches"
     )
 
 
