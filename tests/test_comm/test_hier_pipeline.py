@@ -100,3 +100,54 @@ def test_2d_mesh_hier(case, monkeypatch):
 
 def test_2d_mesh_hier_backward(monkeypatch):
     _run("shared_prefix", hier=True, monkeypatch=monkeypatch, backward=True)
+
+
+@pytest.mark.parametrize("hier", [False, True])
+def test_2d_mesh_video_mask_auto_dispatch(hier, monkeypatch):
+    """Cross-feature: Magi-1-style video block mask + AUTO dispatch on the
+    2D (dcn x ici) mesh, flat and hierarchical casts."""
+    from magiattention_tpu import DistAttnConfig
+    from magiattention_tpu.common.enum import DispatchAlgType
+    from magiattention_tpu.config import DispatchConfig
+    from magiattention_tpu.utils.sparse_utils import (
+        block_mask_to_ranges,
+        make_video_block_mask,
+    )
+
+    if hier:
+        monkeypatch.setenv("MAGI_ATTENTION_HIERARCHICAL_COMM", "1")
+    block, frames = 32, 4  # S = 256 total, window 2 frames
+    bm = make_video_block_mask(frames, S // frames // block, 2)
+    qr_r, kr_r, tm_r = block_mask_to_ranges(bm, block, block)
+    qr = [[r.start, r.end] for r in qr_r]
+    kr = [[r.start, r.end] for r in kr_r]
+    tm = [t.to_int_type() for t in tm_r]
+    mesh = _mesh_2d()
+    key = magi_attn_flex_key(
+        qr, kr, tm, S, S, mesh=mesh, cp_axis=("dcn", "ici"),
+        chunk_size=CHUNK,
+        dist_attn_config=DistAttnConfig(
+            dispatch_config=DispatchConfig(alg=DispatchAlgType.AUTO)
+        ),
+    )
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        [AttnMaskType.from_int_type(t) for t in tm],
+        total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+
+    def fwd(q, k, v):
+        qd = dispatch(q, key)
+        kd = dispatch(k, key, role="kv")
+        vd = dispatch(v, key, role="kv")
+        od, _ = calc_attn(qd, kd, vd, key)
+        return undispatch(od, key)
+
+    out = jax.jit(fwd)(q, k, v)
+    out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
+                 msg=f"2d video auto hier={hier}")
