@@ -216,7 +216,108 @@ void magi_minheap_solve(const int64_t* areas, int64_t n, int64_t cp,
   }
 }
 
+
+// ---------------------------------------------------------------------------
+// FFA tile-plan builder (kernels/ffa_plan.py build_ffa_plan)
+// ---------------------------------------------------------------------------
+// The host-side replacement of the reference's device tile schedulers
+// (csrc/flexible_flash_attention/{fwd,bwd}_tile_scheduler.hpp): enumerate
+// the non-empty (q_tile, k_tile, slice) work items of a band-slice list.
+// Two-pass C ABI: count items per tile, then fill the flattened q-major and
+// k-major work lists (per-tile cursors preserve the slice-order bucketing
+// of the Python builder; is_first/is_last mark run boundaries).
+
+static inline void magi_tile_interact(
+    int64_t i0, int64_t i1, int64_t j0, int64_t j1, int64_t lo, int64_t hi,
+    int* nonempty, int* full) {
+  if (i0 >= i1 || j0 >= j1) { *nonempty = 0; *full = 0; return; }
+  int64_t d_min = j0 - (i1 - 1);
+  int64_t d_max = (j1 - 1) - i0;
+  *nonempty = (d_min <= hi && d_max >= lo) ? 1 : 0;
+  *full = (*nonempty && d_max <= hi && d_min >= lo) ? 1 : 0;
+}
+
+int32_t magi_ffa_plan_count(const int32_t* qr, const int32_t* kr,
+                            const int32_t* lo, const int32_t* hi, int64_t n,
+                            int64_t bq, int64_t bk, int64_t nqt, int64_t nkt,
+                            int64_t* q_counts, int64_t* k_counts) {
+  for (int64_t s = 0; s < n; ++s) {
+    int64_t qs = qr[2 * s], qe = qr[2 * s + 1];
+    int64_t ks = kr[2 * s], ke = kr[2 * s + 1];
+    int64_t l = lo[s], h = hi[s];
+    if (qs >= qe || ks >= ke || l > h) continue;
+    // slices must fit the tile grids (the Python builder raises on the
+    // same input; silent clamping would corrupt the caller's buffers)
+    if (qs < 0 || ks < 0 || (qe + bq - 1) / bq > nqt ||
+        (ke + bk - 1) / bk > nkt)
+      return -1;
+    for (int64_t qt = qs / bq; qt < (qe + bq - 1) / bq; ++qt) {
+      int64_t i0 = std::max(qs, qt * bq), i1 = std::min(qe, (qt + 1) * bq);
+      for (int64_t kt = ks / bk; kt < (ke + bk - 1) / bk; ++kt) {
+        int64_t j0 = std::max(ks, kt * bk), j1 = std::min(ke, (kt + 1) * bk);
+        int ne, fl;
+        magi_tile_interact(i0, i1, j0, j1, l, h, &ne, &fl);
+        if (ne) { q_counts[qt]++; k_counts[kt]++; }
+      }
+    }
+  }
+  return 0;
+}
+
+void magi_ffa_plan_fill(const int32_t* qr, const int32_t* kr,
+                        const int32_t* lo, const int32_t* hi, int64_t n,
+                        int64_t bq, int64_t bk, int64_t nqt, int64_t nkt,
+                        const int64_t* q_off, const int64_t* q_cnt,
+                        const int64_t* k_off, const int64_t* k_cnt,
+                        int32_t* work_qt, int32_t* work_kt, int32_t* meta,
+                        int32_t* work_qt_t, int32_t* work_kt_t,
+                        int32_t* meta_t) {
+  // meta columns: QS QE KS KE DLO DHI IS_FIRST IS_LAST IS_FULL
+  std::vector<int64_t> qc(nqt, 0), kc(nkt, 0);
+  for (int64_t s = 0; s < n; ++s) {
+    int64_t qs = qr[2 * s], qe = qr[2 * s + 1];
+    int64_t ks = kr[2 * s], ke = kr[2 * s + 1];
+    int64_t l = lo[s], h = hi[s];
+    if (qs >= qe || ks >= ke || l > h) continue;
+    for (int64_t qt = qs / bq; qt < (qe + bq - 1) / bq; ++qt) {
+      int64_t i0 = std::max(qs, qt * bq), i1 = std::min(qe, (qt + 1) * bq);
+      for (int64_t kt = ks / bk; kt < (ke + bk - 1) / bk; ++kt) {
+        int64_t j0 = std::max(ks, kt * bk), j1 = std::min(ke, (kt + 1) * bk);
+        int ne, fl;
+        magi_tile_interact(i0, i1, j0, j1, l, h, &ne, &fl);
+        if (!ne) continue;
+        int tile_full =
+            (fl && i0 == qt * bq && i1 == (qt + 1) * bq && j0 == kt * bk &&
+             j1 == (kt + 1) * bk)
+                ? 1
+                : 0;
+        int64_t p = q_off[qt] + qc[qt];
+        work_qt[p] = (int32_t)qt;
+        work_kt[p] = (int32_t)kt;
+        int32_t* m = meta + p * 9;
+        m[0] = (int32_t)qs; m[1] = (int32_t)qe;
+        m[2] = (int32_t)ks; m[3] = (int32_t)ke;
+        m[4] = (int32_t)l;  m[5] = (int32_t)h;
+        m[6] = qc[qt] == 0 ? 1 : 0;
+        m[7] = qc[qt] == q_cnt[qt] - 1 ? 1 : 0;
+        m[8] = tile_full;
+        qc[qt]++;
+        int64_t pt = k_off[kt] + kc[kt];
+        work_qt_t[pt] = (int32_t)qt;
+        work_kt_t[pt] = (int32_t)kt;
+        int32_t* mt = meta_t + pt * 9;
+        std::memcpy(mt, m, 6 * sizeof(int32_t));
+        mt[6] = kc[kt] == 0 ? 1 : 0;
+        mt[7] = kc[kt] == k_cnt[kt] - 1 ? 1 : 0;
+        mt[8] = tile_full;
+        kc[kt]++;
+      }
+    }
+  }
+}
+
 }  // extern "C"
+
 
 // ---------------------------------------------------------------------------
 // dynamic-solver hot loop (ref: csrc/extensions/dyn_solver_alg.cpp:644

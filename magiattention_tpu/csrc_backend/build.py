@@ -19,6 +19,7 @@ from pathlib import Path
 _SRC = Path(__file__).resolve().parents[2] / "csrc" / "magi_host.cpp"
 _LOCK = threading.Lock()
 _LIB: ctypes.CDLL | None = None
+_LIB_ERR: ImportError | None = None  # memoized failure: never retry builds
 
 
 def _cache_dir() -> Path:
@@ -41,24 +42,40 @@ def _build(src: Path, out: Path) -> None:
 
 
 def get_lib() -> ctypes.CDLL:
-    """Build (once, cached by source hash) and load the native library."""
-    global _LIB
+    """Build (once, cached by source hash) and load the native library.
+
+    Failures are memoized (raised as the same ImportError on every later
+    call) so hot paths with a Python fallback — e.g. the default-on native
+    FFA plan builder — never retry a failing toolchain per call.
+    """
+    global _LIB, _LIB_ERR
     if _LIB is not None:
         return _LIB
+    if _LIB_ERR is not None:
+        raise _LIB_ERR
     with _LOCK:
         if _LIB is not None:
             return _LIB
-        if not _SRC.exists():
-            raise ImportError(f"native source missing: {_SRC}")
-        digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
-        so = _cache_dir() / f"magi_host_{digest}.so"
-        if not so.exists():
+        if _LIB_ERR is not None:
+            raise _LIB_ERR
+        try:
+            if not _SRC.exists():
+                raise ImportError(f"native source missing: {_SRC}")
+            digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+            so = _cache_dir() / f"magi_host_{digest}.so"
+            if not so.exists():
+                try:
+                    _build(_SRC, so)
+                except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                    raise ImportError(f"native build failed: {e}") from e
             try:
-                _build(_SRC, so)
-            except (subprocess.CalledProcessError, FileNotFoundError) as e:
-                raise ImportError(f"native build failed: {e}") from e
-        lib = ctypes.CDLL(str(so))
-        _declare(lib)
+                lib = ctypes.CDLL(str(so))
+            except OSError as e:  # stale/foreign .so in a shared cache
+                raise ImportError(f"native lib unloadable: {e}") from e
+            _declare(lib)
+        except ImportError as e:
+            _LIB_ERR = e
+            raise
         _LIB = lib
         return lib
 
@@ -83,4 +100,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.magi_binary_greedy_solve.argtypes = [
         i64p, i64p, i64p, i64p, i64p, i32p, i32p,
         i64, i64, ctypes.c_double, i64, i32p,
+    ]
+    lib.magi_ffa_plan_count.restype = ctypes.c_int32
+    lib.magi_ffa_plan_count.argtypes = [
+        i32p, i32p, i32p, i32p, i64, i64, i64, i64, i64, i64p, i64p,
+    ]
+    lib.magi_ffa_plan_fill.restype = None
+    lib.magi_ffa_plan_fill.argtypes = [
+        i32p, i32p, i32p, i32p, i64, i64, i64, i64, i64,
+        i64p, i64p, i64p, i64p,
+        i32p, i32p, i32p, i32p, i32p, i32p,
     ]

@@ -105,3 +105,76 @@ def ranges_make_local_native(host: np.ndarray, ranges: np.ndarray) -> np.ndarray
 
         raise RangeError("range not fully covered by host ranges")
     return out[:m].copy()
+
+
+def ffa_plan_native(
+    q_ranges: np.ndarray,
+    k_ranges: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+    num_q_tiles: int,
+    num_k_tiles: int,
+    block_q: int,
+    block_k: int,
+    band_inf: int,
+):
+    """Native FFA work-list builder (csrc magi_ffa_plan_{count,fill}).
+
+    Returns the 6 plan arrays (work_qt, work_kt, meta, work_qt_t,
+    work_kt_t, meta_t) with dummy items inserted for empty tiles, matching
+    kernels/ffa_plan.build_ffa_plan exactly.
+    """
+    from ..kernels.ffa_plan import DHI, DLO, IS_FIRST, IS_LAST, META_DIM
+
+    lib = get_lib()
+    qr = np.ascontiguousarray(q_ranges, dtype=np.int32)
+    kr = np.ascontiguousarray(k_ranges, dtype=np.int32)
+    lo = np.ascontiguousarray(d_lo, dtype=np.int32)
+    hi = np.ascontiguousarray(d_hi, dtype=np.int32)
+    n = len(qr)
+    q_counts = np.zeros(num_q_tiles, dtype=np.int64)
+    k_counts = np.zeros(num_k_tiles, dtype=np.int64)
+    rc = lib.magi_ffa_plan_count(
+        _i32p(qr), _i32p(kr), _i32p(lo), _i32p(hi), n,
+        block_q, block_k, num_q_tiles, num_k_tiles,
+        _i64p(q_counts), _i64p(k_counts),
+    )
+    if rc != 0:
+        raise ValueError(
+            "slice metadata outside the tile grid (negative range or "
+            "beyond seqlen)"
+        )
+
+    def alloc(counts, major_is_q: bool):
+        # every empty tile still gets one dummy item (finalize writes zeros)
+        sizes = np.maximum(counts, 1)
+        offsets = np.zeros_like(sizes)
+        np.cumsum(sizes[:-1], out=offsets[1:])
+        total = int(sizes.sum())
+        work_a = np.zeros(total, dtype=np.int32)
+        work_b = np.zeros(total, dtype=np.int32)
+        meta = np.zeros((total, META_DIM), dtype=np.int32)
+        empty = counts == 0
+        if empty.any():
+            pos = offsets[empty]
+            tiles = np.nonzero(empty)[0].astype(np.int32)
+            if major_is_q:
+                work_a[pos] = tiles
+            else:
+                work_b[pos] = tiles
+            meta[pos, DLO] = -band_inf
+            meta[pos, DHI] = band_inf
+            meta[pos, IS_FIRST] = 1
+            meta[pos, IS_LAST] = 1
+        return work_a, work_b, meta, offsets
+
+    work_qt, work_kt, meta, q_off = alloc(q_counts, True)
+    work_qt_t, work_kt_t, meta_t, k_off = alloc(k_counts, False)
+    lib.magi_ffa_plan_fill(
+        _i32p(qr), _i32p(kr), _i32p(lo), _i32p(hi), n,
+        block_q, block_k, num_q_tiles, num_k_tiles,
+        _i64p(q_off), _i64p(q_counts), _i64p(k_off), _i64p(k_counts),
+        _i32p(work_qt), _i32p(work_kt), _i32p(meta),
+        _i32p(work_qt_t), _i32p(work_kt_t), _i32p(meta_t),
+    )
+    return work_qt, work_kt, meta, work_qt_t, work_kt_t, meta_t

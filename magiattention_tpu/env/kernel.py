@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from .general import _get_int
+from .general import _get_int, _get_str
 
 
 def ffa_block_q() -> int:
@@ -18,3 +18,12 @@ def ffa_block_k() -> int:
 def ffa_max_slices() -> int:
     """Static upper bound on slice count per AttnArg (padding bucket)."""
     return _get_int("MAGI_ATTENTION_FFA_MAX_SLICES", 64)
+
+
+def ffa_native_plan() -> str:
+    """Native (C) FFA work-list builder: 'auto' (use when the native lib
+    builds; silently fall back), '1' (require), '0' (pure Python). Unlike
+    MAGI_ATTENTION_CPP_BACKEND (off by default — the range-object FFI churn
+    loses there), the plan builder is pure array marshalling and wins
+    outright, so auto is the default."""
+    return _get_str("MAGI_ATTENTION_NATIVE_FFA_PLAN", "auto").lower()

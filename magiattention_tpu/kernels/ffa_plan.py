@@ -80,6 +80,28 @@ def build_ffa_plan(
     num_q_tiles = max(1, -(-seqlen_q // block_q))
     num_k_tiles = max(1, -(-seqlen_k // block_k))
 
+    from ..env.kernel import ffa_native_plan
+
+    mode = ffa_native_plan()
+    if mode != "0":
+        try:
+            from ..csrc_backend.ops import ffa_plan_native
+
+            arrays = ffa_plan_native(
+                q_ranges, k_ranges, d_lo, d_hi,
+                num_q_tiles, num_k_tiles, block_q, block_k, BAND_INF,
+            )
+            return FFAPlan(
+                work_qt=arrays[0], work_kt=arrays[1], meta=arrays[2],
+                work_qt_t=arrays[3], work_kt_t=arrays[4], meta_t=arrays[5],
+                num_q_tiles=num_q_tiles, num_k_tiles=num_k_tiles,
+                block_q=block_q, block_k=block_k,
+            )
+        except ImportError:
+            if mode == "1":
+                raise
+            # auto: native lib unavailable — pure-Python builder below
+
     n = len(q_ranges)
     q_items: list[list[tuple[int, ...]]] = [[] for _ in range(num_q_tiles)]
     k_items: list[list[tuple[int, ...]]] = [[] for _ in range(num_k_tiles)]
