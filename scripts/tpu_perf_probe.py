@@ -63,7 +63,7 @@ def main():
     kr = np.array([[0, S]], np.int32)
     tm = np.array([1], np.int32)
 
-    for bq, bk in [(256, 512), (512, 512), (512, 1024)]:
+    for bq, bk in [(256, 512), (512, 512), (512, 1024), (1024, 512), (1024, 1024)]:
         try:
             dt = scan_time(
                 lambda q: ffa_attn(q, k, v, qr, kr, tm, block_q=bq,
