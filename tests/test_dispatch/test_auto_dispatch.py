@@ -186,3 +186,26 @@ def test_auto_end_to_end_numeric():
     out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
     assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
                  msg="auto dispatch e2e out")
+
+
+def test_auto_uneven_shard_dedups_candidates():
+    """With uneven_shard most candidates collapse to the same LPT partition;
+    AUTO must still produce a valid (deduped) selection."""
+    from magiattention_tpu.config import DispatchConfig
+
+    cfg = DispatchConfig(alg=DispatchAlgType.AUTO, uneven_shard=True)
+    # 10 chunks over 4 ranks: indivisible, exercises the uneven path
+    s, chunk, cp = 1280, 128, 4
+    bucket = make_global_bucket_from_qk_ranges(
+        AttnRanges.from_ranges([[0, s]]),
+        AttnRanges.from_ranges([[0, s]]),
+        [AttnMaskType.CAUSAL], s, chunk,
+    )
+    areas = bucket.areas_per_chunk
+    parts, alg = _auto_select_partitions(bucket, areas, cp, len(areas), cfg)
+    assert sorted(c for p in parts for c in p) == list(range(len(areas)))
+    assert alg in (
+        DispatchAlgType.MIN_HEAP,
+        DispatchAlgType.TOPP_HEAP,
+        DispatchAlgType.SEQUENTIAL_SELECT,
+    )
