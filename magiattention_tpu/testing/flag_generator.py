@@ -21,6 +21,7 @@ FLAG_SPACE: dict[str, list[str | None]] = {
     "MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE": [None, "0", "1"],
     "MAGI_ATTENTION_CPP_BACKEND": [None, "0", "1"],
     "MAGI_ATTENTION_DETERMINISTIC_MODE": [None, "0", "1"],
+    "MAGI_ATTENTION_NATIVE_FFA_PLAN": [None, "0", "1"],
 }
 
 HEURISTIC_COMBOS: list[dict[str, str]] = [
@@ -30,6 +31,8 @@ HEURISTIC_COMBOS: list[dict[str, str]] = [
      "MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE": "0"},
     {"MAGI_ATTENTION_KERNEL_BACKEND": "sdpa_online",
      "MAGI_ATTENTION_DETERMINISTIC_MODE": "1"},
+    {"MAGI_ATTENTION_KERNEL_BACKEND": "ffa",
+     "MAGI_ATTENTION_NATIVE_FFA_PLAN": "0"},
 ]
 
 
