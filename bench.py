@@ -46,21 +46,6 @@ def run_worker() -> int:
 
     import jax
 
-    if os.environ.get("MAGI_BENCH_FORCE_CPU") != "1":
-        # reuse Mosaic executables compiled in earlier runs/windows — first
-        # compile is 20-40s per kernel variant, which a flaky chip window
-        # may not have. TPU path only: reloading CPU AOT cache entries can
-        # SIGILL on machine-feature mismatch, and the degraded path must
-        # never crash.
-        try:
-            from magiattention_tpu.utils.compile_cache import (
-                enable_persistent_cache,
-            )
-
-            enable_persistent_cache()
-        except Exception:
-            pass
-
     if os.environ.get("MAGI_BENCH_FORCE_CPU") == "1":
         # the axon sitecustomize force-sets JAX_PLATFORMS=axon, overriding
         # the env var — only jax.config reliably pins the degraded path to
@@ -75,6 +60,20 @@ def run_worker() -> int:
     S, HQ, HK, D = 4096, 16, 8, 128
     dtype = jnp.bfloat16
     backend = jax.default_backend()
+    if backend == "tpu":
+        # reuse Mosaic executables compiled in earlier runs/windows — first
+        # compile is 20-40s per kernel variant, which a flaky chip window
+        # may not have. Gated on the *resolved* backend: reloading CPU AOT
+        # cache entries can SIGILL on machine-feature mismatch, and the
+        # degraded path must never crash.
+        try:
+            from magiattention_tpu.utils.compile_cache import (
+                enable_persistent_cache,
+            )
+
+            enable_persistent_cache()
+        except Exception:
+            pass
     if backend == "cpu":
         # interpret-mode fallback (no TPU attached): tiny shape, still emits
         S, HQ, HK, D = 512, 4, 2, 64

@@ -15,6 +15,31 @@ def ffa_block_k() -> int:
     return _get_int("MAGI_ATTENTION_FFA_BLOCK_K", 512)
 
 
+def ffa_block_q_dq() -> int:
+    """Q tile rows for the dq backward kernel; 0 = inherit FFA_BLOCK_Q.
+    (TPU analogue of the reference's FFA BWD tuning flags,
+    docs/source/user_guide/env_variables.md:111.) Must divide the fwd-padded
+    seqlen; incompatible values silently inherit."""
+    return _get_int("MAGI_ATTENTION_FFA_BLOCK_Q_DQ", 0)
+
+
+def ffa_block_k_dq() -> int:
+    """K tile rows for the dq backward kernel; 0 = inherit FFA_BLOCK_K."""
+    return _get_int("MAGI_ATTENTION_FFA_BLOCK_K_DQ", 0)
+
+
+def ffa_block_q_dkv() -> int:
+    """Q tile rows for the dk/dv backward kernel; 0 = inherit FFA_BLOCK_Q."""
+    return _get_int("MAGI_ATTENTION_FFA_BLOCK_Q_DKV", 0)
+
+
+def ffa_block_k_dkv() -> int:
+    """K tile rows for the dk/dv backward kernel; 0 = inherit FFA_BLOCK_K.
+    The dkv kernel holds (bk, d)+(bk, dv) fp32 scratch, so smaller bk eases
+    VMEM pressure at large head_dim."""
+    return _get_int("MAGI_ATTENTION_FFA_BLOCK_K_DKV", 0)
+
+
 def ffa_max_slices() -> int:
     """Static upper bound on slice count per AttnArg (padding bucket)."""
     return _get_int("MAGI_ATTENTION_FFA_MAX_SLICES", 64)

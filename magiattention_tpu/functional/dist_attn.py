@@ -38,6 +38,7 @@ from ..env import comm as env_comm
 from ..env import general as env_general
 from ..kernels.ffa import (
     FFAParams,
+    _bwd_plan_slices,
     _ffa_bwd_dkv_pallas,
     _ffa_bwd_dq_pallas,
     _ffa_fwd_pallas,
@@ -115,11 +116,12 @@ def _multi_ffa_bwd(params_list, res, cts):
             lse, ((0, sqp - sq), (0, 0)), constant_values=float("-inf")
         ).T
         delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
+        dq_arrs, dkv_arrs = _bwd_plan_slices(arrs)
         dq_t = _ffa_bwd_dq_pallas(
-            prm, *arrs[:3], q_t, k_t, v_t, do_t, lse_t, delta_t
+            prm, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
         )
         dk_t, dv_t = _ffa_bwd_dkv_pallas(
-            prm, *arrs[3:6], q_t, k_t, v_t, do_t, lse_t, delta_t
+            prm, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
         )
         # dk/dv already per kv head (dkv kernel sums the GQA group)
         dq = dq_t.transpose(1, 0, 2)[:sq].astype(q.dtype)
@@ -168,22 +170,49 @@ def _ragged_arrays(s) -> tuple[jax.Array, ...]:
 
 
 def _stack_plans(args: list[AttnArg], sq: int, sk: int, bq: int, bk: int):
-    """Per-rank FFA plans -> rank-stacked arrays padded to a common size."""
-    plans = [
-        build_ffa_plan(
-            a.q_ranges, a.k_ranges, a.d_lo, a.d_hi, sq, sk, bq, bk
+    """Per-rank FFA plans -> rank-stacked arrays padded to a common size.
+
+    Returns ``(stacked_arrays, dims)`` where dims feeds
+    ``DistAttnRuntime._ffa_params``. When the env bwd-tile overrides
+    (MAGI_ATTENTION_FFA_BLOCK_*_D{Q,KV}) are active and compatible with
+    this plan group's padded geometry, the stack carries 12 arrays (fwd6 +
+    dq3 + dkv3) and dims includes the FFAParams override fields — so the
+    distributed runtimes honor the same tuning flags as single-device
+    ``ffa_attn``.
+    """
+    from ..kernels.ffa import assemble_bwd_overrides
+
+    def build_stack(blq: int, blk: int, fields: tuple[str, ...]):
+        plans = [
+            build_ffa_plan(
+                a.q_ranges, a.k_ranges, a.d_lo, a.d_hi, sq, sk, blq, blk
+            )
+            for a in args
+        ]
+        w = max(p.num_work for p in plans)
+        wt = max(p.num_work_t for p in plans)
+        padded = [pad_plan(p, w, wt) for p in plans]
+        stacked = tuple(
+            jnp.asarray(np.stack([getattr(p, f) for p in padded]))
+            for f in fields
         )
-        for a in args
-    ]
-    w = max(p.num_work for p in plans)
-    wt = max(p.num_work_t for p in plans)
-    padded = [pad_plan(p, w, wt) for p in plans]
-    stacked = tuple(
-        jnp.asarray(np.stack([getattr(p, f) for p in padded]))
-        for f in ("work_qt", "work_kt", "meta", "work_qt_t", "work_kt_t",
+        return stacked, plans[0].num_q_tiles, plans[0].num_k_tiles, w, wt
+
+    fwd_fields = ("work_qt", "work_kt", "meta", "work_qt_t", "work_kt_t",
                   "meta_t")
+    stacked, nqt, nkt, w, wt = build_stack(bq, bk, fwd_fields)
+
+    def build_triple(blocks, kind):
+        if kind == "dq":
+            triple, _, _, w2, _ = build_stack(*blocks, fwd_fields[0:3])
+            return triple, w2
+        triple, _, _, _, wt2 = build_stack(*blocks, fwd_fields[3:6])
+        return triple, wt2
+
+    stacked, overrides = assemble_bwd_overrides(
+        stacked, bq, bk, nqt, nkt, build_triple
     )
-    return stacked, plans[0].num_q_tiles, plans[0].num_k_tiles, w, wt
+    return stacked, (nqt, nkt, w, wt, overrides)
 
 
 @dataclass(eq=False)
@@ -223,27 +252,25 @@ class DistAttnRuntime:
         self._bq, self._bk = bq, bk
 
         # merged (no-overlap) plan
-        (self._merged_arrays, nqt, nkt, w, wt) = _stack_plans(
+        self._merged_arrays, self._merged_dims = _stack_plans(
             km.merged_args, shard, kv_shard + total_recv, bq, bk
         )
-        self._merged_dims = (nqt, nkt, w, wt)
 
         if self.use_overlap:
-            (self._host_arrays, hnqt, hnkt, hw, hwt) = _stack_plans(
+            self._host_arrays, self._host_dims = _stack_plans(
                 km.host_args, shard, kv_shard,
                 bq, min(bk, _ceil_to(kv_shard, 128)),
             )
-            self._host_dims = (hnqt, hnkt, hw, hwt)
             self._stage_arrays = []
             self._stage_dims = []
             for st in range(self.num_stages):
                 rl = km.recv_len_per_stage[st]
-                sa, snqt, snkt, sw, swt = _stack_plans(
+                sa, sdims = _stack_plans(
                     km.remote_args_per_stage[st], shard, rl,
                     bq, min(bk, _ceil_to(rl, 128)),
                 )
                 self._stage_arrays.append(sa)
-                self._stage_dims.append((snqt, snkt, sw, swt))
+                self._stage_dims.append(sdims)
 
         # comm arrays (host-planned, stacked over ranks)
         self._hier = (
@@ -279,11 +306,13 @@ class DistAttnRuntime:
             self._cast_ops = self._hier_arrays
             self._cast_kinds = [("hier",)] * len(self._hier_arrays)
         else:
-            use_ragged = env_comm.is_ragged_grpcoll_enable()
+            # per-stage tier from the solver's AUTO choice (s.lowering);
+            # the ragged tier only appears there when the backend supports
+            # it (env_comm.is_ragged_grpcoll_enable at plan time)
             self._cast_ops = []
             self._cast_kinds = []
             for s in cm.kv_stages:
-                if use_ragged:
+                if s.lowering == "ragged":
                     self._cast_ops.append(_ragged_arrays(s))
                     self._cast_kinds.append(("ragged", s.r_max))
                 elif s.lowering == "ppermute":
@@ -342,10 +371,10 @@ class DistAttnRuntime:
     def _ffa_params(
         self, dims, scale, group, emit_max_logits: bool = False
     ) -> FFAParams:
-        nqt, nkt, w, wt = dims
+        nqt, nkt, w, wt, overrides = dims
         return FFAParams(
             num_work=w, num_work_t=wt, num_q_tiles=nqt, num_k_tiles=nkt,
-            block_q=self._bq, block_k=self._bk,
+            block_q=self._bq, block_k=self._bk, **overrides,
             softmax_scale=scale, softcap=self.softcap, group=group,
             interpret=_should_interpret(),
             # the max-logits output costs an (hq, sqp, 128) fp32 HBM write

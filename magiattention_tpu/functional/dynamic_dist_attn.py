@@ -35,6 +35,7 @@ from ..comm.primitives import cast_rows, reduce_rows
 from ..env import general as env_general
 from ..kernels.ffa import (
     FFAParams,
+    _bwd_plan_slices,
     _ffa_bwd_dkv_pallas,
     _ffa_bwd_dq_pallas,
     _should_interpret,
@@ -139,11 +140,12 @@ def _dyn_bwd(static, axis, res, cts):
     ).T
     delta_t = jnp.pad(delta_buf, ((0, sqp - nbuf), (0, 0))).T
 
+    dq_arrs, dkv_arrs = _bwd_plan_slices(arrays)
     dq_t = _ffa_bwd_dq_pallas(
-        params, *arrays[:3], q_t, k_t, v_t, do_t, lse_t, delta_t
+        params, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     dk_t, dv_t = _ffa_bwd_dkv_pallas(
-        params, *arrays[3:6], q_t, k_t, v_t, do_t, lse_t, delta_t
+        params, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     # dk/dv already per kv head (dkv kernel sums the GQA group)
 
@@ -186,16 +188,12 @@ class DynamicDistAttnRuntime:
         bq, bk = default_blocks(p.q_buf_len, p.k_buf_len,
                                 self.block_q, self.block_k)
         self._bq, self._bk = bq, bk
-        (self._arrays, nqt, nkt, w, wt) = _stack_plans(
+        self._arrays, self._dims = _stack_plans(
             p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk
         )
-        self._dims = (nqt, nkt, w, wt)
-        from ..env import comm as env_comm
-
-        use_ragged = env_comm.is_ragged_grpcoll_enable()
-
         def ops_of(cast):
-            if use_ragged:
+            # per-stage tier from the solver's AUTO choice (cast.lowering)
+            if cast.lowering == "ragged":
                 from .dist_attn import _ragged_arrays
 
                 return (_ragged_arrays(cast), ("ragged", cast.r_max))
@@ -249,10 +247,10 @@ class DynamicDistAttnRuntime:
         if self.backend in ("sdpa", "sdpa_online"):
             return self._calc_attn_sdpa(q, k, v, scale, return_max_logits)
 
-        nqt, nkt, w, wt = self._dims
+        nqt, nkt, w, wt, overrides = self._dims
         params = FFAParams(
             num_work=w, num_work_t=wt, num_q_tiles=nqt, num_k_tiles=nkt,
-            block_q=self._bq, block_k=self._bk,
+            block_q=self._bq, block_k=self._bk, **overrides,
             softmax_scale=scale, softcap=self.softcap, group=group,
             interpret=_should_interpret(),
             emit_max_logits=return_max_logits,

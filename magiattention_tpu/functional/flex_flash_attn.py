@@ -162,6 +162,7 @@ def _ffa_with_sink(
     from ..kernels.ffa import (
         FFAParams,
         _should_interpret,
+        apply_bwd_overrides,
         default_blocks,
         get_ffa_plan,
         plan_arrays,
@@ -181,14 +182,18 @@ def _ffa_with_sink(
     scale = float(d) ** -0.5 if softmax_scale is None else float(softmax_scale)
     bq, bk = default_blocks(sq, sk)
     plan = get_ffa_plan(qr_np, kr_np, d_lo, d_hi, sq, sk, bq, bk)
+    arrays, overrides = apply_bwd_overrides(
+        plan_arrays(plan), qr_np, kr_np, d_lo, d_hi, sq, sk, bq, bk,
+        plan.num_q_tiles, plan.num_k_tiles,
+    )
     params = FFAParams(
         num_work=plan.num_work, num_work_t=plan.num_work_t,
         num_q_tiles=plan.num_q_tiles, num_k_tiles=plan.num_k_tiles,
-        block_q=bq, block_k=bk, softmax_scale=scale,
+        block_q=bq, block_k=bk, **overrides, softmax_scale=scale,
         softcap=float(softcap), group=hq // hk,
         interpret=_should_interpret(),
     )
-    return _ffa_sink_core(q, k, v, sink, plan_arrays(plan), params)
+    return _ffa_sink_core(q, k, v, sink, arrays, params)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(5,))
@@ -219,7 +224,11 @@ def _ffa_sink_core_fwd(q, k, v, sink, arrays, params):
 
 
 def _ffa_sink_core_bwd(params, res, cts):
-    from ..kernels.ffa import _ffa_bwd_dkv_pallas, _ffa_bwd_dq_pallas
+    from ..kernels.ffa import (
+        _bwd_plan_slices,
+        _ffa_bwd_dkv_pallas,
+        _ffa_bwd_dq_pallas,
+    )
     from .dist_attn import _head_major
     from .sink import sink_bwd
 
@@ -237,11 +246,12 @@ def _ffa_sink_core_bwd(params, res, cts):
         lse, ((0, sqp - sq), (0, 0)), constant_values=float("-inf")
     ).T
     delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
+    dq_arrs, dkv_arrs = _bwd_plan_slices(arrays)
     dq_t = _ffa_bwd_dq_pallas(
-        params, *arrays[:3], q_t, k_t, v_t, do_t, lse_t, delta_t
+        params, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     dk_t, dv_t = _ffa_bwd_dkv_pallas(
-        params, *arrays[3:6], q_t, k_t, v_t, do_t, lse_t, delta_t
+        params, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     # dk/dv already per kv head (dkv kernel sums the GQA group)
     dsink = sink_bwd(sink, lse, delta)

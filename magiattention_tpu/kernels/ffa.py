@@ -106,6 +106,27 @@ class FFAParams:
     # extra (hq, sqp, 128) fp32 HBM write, so it is opt-in; when off, the
     # returned max_logits is a constant -inf placeholder.
     emit_max_logits: bool = False
+    # Backward-specific tile overrides (TPU analogue of the reference's FFA
+    # BWD tuning flags, docs/source/user_guide/env_variables.md:111): the dq
+    # and dkv kernels have different VMEM/compute profiles than fwd (dkv
+    # holds (bk, d)+(bk, dv) fp32 scratch and loops the GQA group innermost),
+    # so they may want their own block sizes. None = inherit fwd blocks.
+    # When set, the plan tuple carries 12 arrays (fwd6 + dq3 + dkv3) and
+    # num_work_dq / num_work_dkv are the respective work counts.
+    block_q_dq: int | None = None
+    block_k_dq: int | None = None
+    block_q_dkv: int | None = None
+    block_k_dkv: int | None = None
+    num_work_dq: int | None = None
+    num_work_dkv: int | None = None
+
+    def dq_blocks(self) -> tuple[int, int]:
+        return (self.block_q_dq or self.block_q,
+                self.block_k_dq or self.block_k)
+
+    def dkv_blocks(self) -> tuple[int, int]:
+        return (self.block_q_dkv or self.block_q,
+                self.block_k_dkv or self.block_k)
 
 
 def plan_arrays(plan: FFAPlan) -> tuple[jax.Array, ...]:
@@ -468,11 +489,11 @@ def _clamp_lse(lse_t: jax.Array) -> jax.Array:
 def _ffa_bwd_dq_pallas(
     params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t, delta_t
 ):
-    bq, bk = params.block_q, params.block_k
+    bq, bk = params.dq_blocks()
     hq, sqp, d = q_t.shape
     _, _, dv = v_t.shape
     g = params.group
-    W = params.num_work
+    W = params.num_work_dq if params.num_work_dq is not None else params.num_work
 
     # pre-scale q (exp2 domain when softcap-free); the missing scale factor
     # on ds is applied to dq on return
@@ -635,11 +656,15 @@ def _ffa_bwd_dkv_pallas(
     params: FFAParams, work_qt_t, work_kt_t, meta_t,
     q_t, k_t, v_t, do_t, lse_t, delta_t,
 ):
-    bq, bk = params.block_q, params.block_k
+    bq, bk = params.dkv_blocks()
     hq, sqp, d = q_t.shape
     hk, skp, dv = v_t.shape
     g = params.group
-    WT = params.num_work_t
+    WT = (
+        params.num_work_dkv
+        if params.num_work_dkv is not None
+        else params.num_work_t
+    )
 
     # pre-scale q: dk = ds_t @ q' carries the scale factor exactly; the
     # exp2-path log2e factor is divided back out of dk on return
@@ -726,23 +751,26 @@ def _ffa_bwd_dkv_pallas(
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(9,))
-def _ffa_core(
-    q_t, k_t, v_t, work_qt, work_kt, meta, work_qt_t, work_kt_t, meta_t,
-    params: FFAParams,
-):
-    return _ffa_fwd_pallas(params, work_qt, work_kt, meta, q_t, k_t, v_t)
+def _bwd_plan_slices(arrays: tuple):
+    """(dq_triple, dkv_triple) of a 6- or 12-array plan tuple.
+
+    6 arrays: dq shares the fwd q-major triple, dkv the k-major triple.
+    12 arrays: fwd6 + dq-specific q-major triple + dkv-specific k-major
+    triple (built with the bwd block overrides, see FFAParams).
+    """
+    if len(arrays) == 12:
+        return arrays[6:9], arrays[9:12]
+    return arrays[0:3], arrays[3:6]
 
 
-def _ffa_core_fwd(
-    q_t, k_t, v_t, work_qt, work_kt, meta, work_qt_t, work_kt_t, meta_t,
-    params: FFAParams,
-):
-    out_t, lse_t, ml = _ffa_fwd_pallas(
-        params, work_qt, work_kt, meta, q_t, k_t, v_t
-    )
-    res = (q_t, k_t, v_t, out_t, lse_t, work_qt, work_kt, meta,
-           work_qt_t, work_kt_t, meta_t)
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _ffa_core(q_t, k_t, v_t, arrays, params: FFAParams):
+    return _ffa_fwd_pallas(params, *arrays[0:3], q_t, k_t, v_t)
+
+
+def _ffa_core_fwd(q_t, k_t, v_t, arrays, params: FFAParams):
+    out_t, lse_t, ml = _ffa_fwd_pallas(params, *arrays[0:3], q_t, k_t, v_t)
+    res = (q_t, k_t, v_t, out_t, lse_t, arrays)
     return (out_t, lse_t, ml), res
 
 
@@ -751,17 +779,16 @@ def _ffa_core_bwd(params: FFAParams, res, cts):
     # CP runtime differentiates the lse-merge manually, matching the
     # reference).
     do_t, _, _ = cts
-    (q_t, k_t, v_t, out_t, lse_t, work_qt, work_kt, meta,
-     work_qt_t, work_kt_t, meta_t) = res
+    q_t, k_t, v_t, out_t, lse_t, arrays = res
+    dq_arrays, dkv_arrays = _bwd_plan_slices(arrays)
     delta_t = jnp.sum(
         do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
     )  # (hq, sqp)
     dq_t = _ffa_bwd_dq_pallas(
-        params, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t, delta_t
+        params, *dq_arrays, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     dk_t, dv_t = _ffa_bwd_dkv_pallas(
-        params, work_qt_t, work_kt_t, meta_t,
-        q_t, k_t, v_t, do_t, lse_t, delta_t,
+        params, *dkv_arrays, q_t, k_t, v_t, do_t, lse_t, delta_t,
     )
     # dk/dv already come back per kv head: the dkv kernel accumulates the
     # GQA group in-kernel (no host reshape-sum)
@@ -769,7 +796,7 @@ def _ffa_core_bwd(params: FFAParams, res, cts):
         dq_t.astype(q_t.dtype),
         dk_t.astype(k_t.dtype),
         dv_t.astype(v_t.dtype),
-        None, None, None, None, None, None,
+        tuple(None for _ in arrays),
     )
 
 
@@ -795,9 +822,10 @@ def ffa_attn_with_plan(
 
     Args:
         q/k/v: ``[sq,hq,d] / [sk,hk,d] / [sk,hk,dv]``, seq-major.
-        arrays: the 6 plan arrays (:func:`plan_arrays`), possibly traced
-            (per-rank metadata under shard_map), padded to params.num_work /
-            params.num_work_t.
+        arrays: the 6 plan arrays (:func:`plan_arrays`) — or 12 when
+            bwd-specific block overrides are active (fwd6 + dq3 + dkv3, see
+            FFAParams) — possibly traced (per-rank metadata under
+            shard_map), padded to params.num_work / params.num_work_t.
         params: static dims + scalars; sq/sk must fit the tile counts.
 
     Returns:
@@ -811,12 +839,97 @@ def ffa_attn_with_plan(
     q_t = jnp.pad(q, ((0, sqp - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
     k_t = jnp.pad(k, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
     v_t = jnp.pad(v, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
-    out_t, lse_t, ml = _ffa_core(q_t, k_t, v_t, *arrays, params)
+    out_t, lse_t, ml = _ffa_core(q_t, k_t, v_t, tuple(arrays), params)
     out = out_t.transpose(1, 0, 2)[:sq]
     lse = lse_t.T[:sq]
     if return_max_logits:
         return out, lse, ml
     return out, lse
+
+
+def resolve_bwd_overrides(
+    bq: int, bk: int, sqp: int, skp: int
+) -> tuple[tuple[int, int] | None, tuple[int, int] | None]:
+    """Env bwd-tile overrides resolved against a padded geometry.
+
+    Returns ``(dq_blocks, dkv_blocks)``; an entry is None when unset or
+    incompatible (the bwd kernels index the same padded q/k/v and lse
+    buffers as fwd, so the override must divide the fwd-padded geometry and
+    satisfy TPU alignment — incompatible values silently inherit fwd's).
+    """
+
+    def gate(env_bq: int, env_bk: int) -> tuple[int, int] | None:
+        obq = env_bq or bq
+        obk = env_bk or bk
+        obq, obk = min(obq, sqp), min(obk, skp)
+        if (
+            (obq, obk) == (bq, bk)
+            or sqp % obq or skp % obk
+            or obq % 8 or obk % 128
+        ):
+            return None
+        return obq, obk
+
+    return (
+        gate(env_kernel.ffa_block_q_dq(), env_kernel.ffa_block_k_dq()),
+        gate(env_kernel.ffa_block_q_dkv(), env_kernel.ffa_block_k_dkv()),
+    )
+
+
+def assemble_bwd_overrides(
+    arrays: tuple, bq: int, bk: int, num_q_tiles: int, num_k_tiles: int,
+    build_triple,
+) -> tuple[tuple, dict]:
+    """Shared override assembly for single-device and stacked (CP) plans —
+    ONE place defines the 12-array layout and FFAParams override fields.
+
+    Args:
+        arrays: the 6 fwd plan arrays (possibly rank-stacked).
+        build_triple: ``(blocks, kind) -> (triple, work_count)`` — kind
+            "dq" returns a q-major triple + its num_work cap; "dkv" a
+            k-major triple + its num_work_t cap.
+
+    Returns ``(arrays, FFAParams-field overrides)`` — arrays extended to 12
+    when an override is active.
+    """
+    dq_blocks, dkv_blocks = resolve_bwd_overrides(
+        bq, bk, num_q_tiles * bq, num_k_tiles * bk
+    )
+    overrides: dict = {}
+    if not (dq_blocks or dkv_blocks):
+        return tuple(arrays), overrides
+    dq_triple = tuple(arrays[0:3])
+    dkv_triple = tuple(arrays[3:6])
+    if dq_blocks:
+        dq_triple, w_dq = build_triple(dq_blocks, "dq")
+        overrides.update(
+            block_q_dq=dq_blocks[0], block_k_dq=dq_blocks[1],
+            num_work_dq=w_dq,
+        )
+    if dkv_blocks:
+        dkv_triple, wt_dkv = build_triple(dkv_blocks, "dkv")
+        overrides.update(
+            block_q_dkv=dkv_blocks[0], block_k_dkv=dkv_blocks[1],
+            num_work_dkv=wt_dkv,
+        )
+    return tuple(arrays) + tuple(dq_triple) + tuple(dkv_triple), overrides
+
+
+def apply_bwd_overrides(
+    arrays: tuple, qr, kr, d_lo, d_hi, sq: int, sk: int, bq: int, bk: int,
+    num_q_tiles: int, num_k_tiles: int,
+) -> tuple[tuple, dict]:
+    """Single-plan wrapper of :func:`assemble_bwd_overrides`."""
+
+    def build_triple(blocks, kind):
+        p = get_ffa_plan(qr, kr, d_lo, d_hi, sq, sk, *blocks)
+        if kind == "dq":
+            return plan_arrays(p)[0:3], p.num_work
+        return plan_arrays(p)[3:6], p.num_work_t
+
+    return assemble_bwd_overrides(
+        arrays, bq, bk, num_q_tiles, num_k_tiles, build_triple
+    )
 
 
 def default_blocks(sq: int, sk: int, block_q=None, block_k=None) -> tuple[int, int]:
@@ -874,6 +987,12 @@ def ffa_attn(
     bq, bk = default_blocks(sq, sk, block_q, block_k)
 
     plan = get_ffa_plan(qr, kr, d_lo, d_hi, sq, sk, bq, bk)
+    arrays = plan_arrays(plan)
+    arrays, overrides = apply_bwd_overrides(
+        arrays, qr, kr, d_lo, d_hi, sq, sk, bq, bk,
+        plan.num_q_tiles, plan.num_k_tiles,
+    )
+
     params = FFAParams(
         num_work=plan.num_work,
         num_work_t=plan.num_work_t,
@@ -886,7 +1005,8 @@ def ffa_attn(
         group=hq // hk,
         interpret=_should_interpret(),
         emit_max_logits=return_max_logits,
+        **overrides,
     )
     return ffa_attn_with_plan(
-        q, k, v, plan_arrays(plan), params, return_max_logits=return_max_logits
+        q, k, v, arrays, params, return_max_logits=return_max_logits
     )

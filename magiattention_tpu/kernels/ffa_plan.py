@@ -112,6 +112,19 @@ def build_ffa_plan(
         lo, hi = int(d_lo[s]), int(d_hi[s])
         if qs >= qe or ks >= ke or lo > hi:
             continue
+        # same bounds validation as the native builder (csrc/magi_host.cpp:251
+        # returns -1 -> ops.py raises): without it, negative starts would
+        # silently wrap via Python negative indexing and corrupt the plan
+        if (
+            qs < 0
+            or ks < 0
+            or -(-qe // block_q) > num_q_tiles
+            or -(-ke // block_k) > num_k_tiles
+        ):
+            raise ValueError(
+                f"ffa plan slice {s} out of bounds: q[{qs},{qe}) "
+                f"k[{ks},{ke}) vs grid {num_q_tiles}x{num_k_tiles} tiles"
+            )
         qt_lo, qt_hi = qs // block_q, -(-qe // block_q)
         kt_lo, kt_hi = ks // block_k, -(-ke // block_k)
         for qt in range(qt_lo, qt_hi):

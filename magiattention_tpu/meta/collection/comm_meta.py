@@ -29,17 +29,21 @@ from ...common.ranges import AttnRanges
 class GroupCollectiveArg:
     """One GroupCast stage over the whole mesh.
 
-    Two interchangeable wire lowerings are planned host-side and the cheaper
-    one is picked per stage (``lowering``):
+    Three interchangeable wire lowerings are planned host-side and the
+    cheapest available one is picked per stage (``lowering``):
 
     - ``a2a``: dense equal-split ``jax.lax.all_to_all`` — every (src,dst)
       pair padded to ``a_cap`` (max pair rows). Wire rows/rank = cp * a_cap.
     - ``ppermute``: one ``jax.lax.ppermute`` round per active ring distance
       delta, each padded only to that distance's max pair (``pp_caps``).
-      Wire rows/rank = sum(pp_caps). For skewed masks (causal) this is the
-      TPU counterpart of the reference's true per-pair a2av split sizes
-      (magi_attention/comm/primitive/grpcoll/utils.py:593) — near
-      zero-redundant instead of cp x max-pair.
+      Wire rows/rank = sum(pp_caps). For skewed masks (causal) this is near
+      zero-redundant instead of cp x max-pair. Portable to every backend.
+    - ``ragged``: ``jax.lax.ragged_all_to_all`` — true per-pair split sizes,
+      exactly zero padding on the wire (the TPU counterpart of the
+      reference's native grpcoll kernels, csrc/comm/grpcoll/, splits per
+      grpcoll/utils.py:593). TPU-only (XLA:CPU lacks the op — verified
+      UNIMPLEMENTED in XLA:CPU ThunkEmitter as of jax 0.9), so it enters
+      the candidate set only when env_comm.is_ragged_grpcoll_enable().
     """
 
     # [dst][src] -> global k ranges src sends to dst (the transfer table,
@@ -76,14 +80,34 @@ class GroupCollectiveArg:
         """Rows crossing the wire (whole mesh) under a lowering, padding
         included — the denominator of the zero-redundancy claim."""
         cp = self.send_counts.shape[0]
-        if (lowering or self.lowering) == "ppermute":
+        kind = lowering or self.lowering
+        if kind == "ppermute":
             return cp * int(sum(self.pp_caps))
+        if kind == "ragged":
+            # true per-pair splits: only off-diagonal payload crosses the
+            # wire (src==dst segments are local copies)
+            return self.payload_rows()
         return cp * cp * self.a_cap
 
     def wire_ratio(self) -> float:
         """wire/payload under the chosen lowering (1.0 = zero-redundant)."""
         payload = self.payload_rows()
         return self.wire_rows() / payload if payload else 1.0
+
+
+def pick_lowering(arg: GroupCollectiveArg) -> str:
+    """Per-stage AUTO wire-tier choice, shared by the static and dynamic
+    solvers: cheapest available lowering by wire rows. The ragged tier's
+    wire volume is the true payload (zero padding) so it wins whenever
+    available (TPU); ties also go to it."""
+    from ...env import comm as env_comm
+
+    candidates = ["a2a"]
+    if sum(arg.pp_caps):
+        candidates.insert(0, "ppermute")
+    if env_comm.is_ragged_grpcoll_enable():
+        candidates.insert(0, "ragged")
+    return min(candidates, key=arg.wire_rows)
 
 
 def build_pp_lowering(

@@ -506,8 +506,6 @@ class DistAttnSolver:
             lambda s, d: np.concatenate(send_chunks[s][d]),
             recv_parts, r_max, min(self.split_alignment, 8),
         )
-        sum_caps = sum(caps)
-
         arg = GroupCollectiveArg(
             transfer_table=transfer_table,
             send_idx=send_idx,
@@ -521,8 +519,9 @@ class DistAttnSolver:
             pp_send_idx=pp_send_idx,
             pp_recv_sel=pp_recv_sel,
         )
-        if sum_caps and arg.wire_rows("ppermute") < arg.wire_rows("a2a"):
-            arg.lowering = "ppermute"
+        from ..collection.comm_meta import pick_lowering
+
+        arg.lowering = pick_lowering(arg)
         return arg
 
 

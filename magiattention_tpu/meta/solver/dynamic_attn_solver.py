@@ -419,8 +419,6 @@ def _make_cast_arg(
     deltas, caps, pp_send_idx, pp_recv_sel = build_pp_lowering(
         pair_rows, _rows_for, recv_parts, r_max, min(alignment, 8)
     )
-    sum_caps = sum(caps)
-
     arg = GroupCollectiveArg(
         transfer_table=transfer_table,
         send_idx=send_idx,
@@ -434,6 +432,7 @@ def _make_cast_arg(
         pp_send_idx=pp_send_idx,
         pp_recv_sel=pp_recv_sel,
     )
-    if sum_caps and arg.wire_rows("ppermute") < arg.wire_rows("a2a"):
-        arg.lowering = "ppermute"
+    from ..collection.comm_meta import pick_lowering
+
+    arg.lowering = pick_lowering(arg)
     return arg

@@ -82,7 +82,12 @@ def baseline_params(
     plan0, w: int, wt: int, bq: int, bk: int,
     scale: float, hq: int, hk: int,
 ) -> FFAParams:
-    """The FFAParams every baseline shares (softcap-free, env interpret)."""
+    """The FFAParams every baseline shares (softcap-free, env interpret).
+
+    The bwd-tile override flags (MAGI_ATTENTION_FFA_BLOCK_*_D{Q,KV}) are
+    deliberately NOT honored here: baselines are fixed comparison targets,
+    so their kernel configuration stays pinned to the fwd blocks.
+    """
     return FFAParams(
         num_work=w, num_work_t=wt,
         num_q_tiles=plan0.num_q_tiles,

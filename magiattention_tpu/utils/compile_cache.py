@@ -32,6 +32,8 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     path = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT_DIR
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # Small nonzero floor: the 20-40s Mosaic kernels this cache exists for
+    # are far above it, while trivial sub-second compiles stay out of the
+    # cache dir (which has no eviction).
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     return path

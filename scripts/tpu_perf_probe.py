@@ -63,25 +63,31 @@ def main():
     kr = np.array([[0, S]], np.int32)
     tm = np.array([1], np.int32)
 
-    for bq, bk in [(256, 512), (512, 512), (512, 1024), (1024, 512), (1024, 1024)]:
+    def time_fwd(bq, bk):
+        dt = scan_time(
+            lambda q: ffa_attn(q, k, v, qr, kr, tm, block_q=bq,
+                               block_k=bk)[0].astype(jnp.bfloat16),
+            q0, length=6, reps=2,
+        )
+        return dt, 4 * area * D * HQ / (dt * 1e-3) / 1e12
+
+    def time_fwd_bwd(bq, bk):
+        def loss(q, k, v):
+            o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+
+        g = jax.grad(loss, argnums=(0, 1, 2))
+        dtb = scan_time(
+            lambda q: (q + 1e-3 * g(q, k, v)[0].astype(jnp.bfloat16)).astype(jnp.bfloat16),
+            q0, length=6, reps=2,
+        )
+        return dtb, 4 * area * D * HQ * 3.5 / (dtb * 1e-3) / 1e12
+
+    for bq, bk in [(256, 512), (512, 512), (512, 1024), (1024, 512),
+                   (1024, 1024), (512, 2048), (1024, 2048), (2048, 512)]:
         try:
-            dt = scan_time(
-                lambda q: ffa_attn(q, k, v, qr, kr, tm, block_q=bq,
-                                   block_k=bk)[0].astype(jnp.bfloat16),
-                q0, length=6, reps=2,
-            )
-            tf = 4 * area * D * HQ / (dt * 1e-3) / 1e12
-
-            def loss(q, k, v):
-                o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=bq, block_k=bk)
-                return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
-
-            g = jax.grad(loss, argnums=(0, 1, 2))
-            dtb = scan_time(
-                lambda q: (q + 1e-3 * g(q, k, v)[0].astype(jnp.bfloat16)).astype(jnp.bfloat16),
-                q0, length=6, reps=2,
-            )
-            tfb = 4 * area * D * HQ * 3.5 / (dtb * 1e-3) / 1e12
+            dt, tf = time_fwd(bq, bk)
+            dtb, tfb = time_fwd_bwd(bq, bk)
             print(
                 f"ffa bq={bq} bk={bk}: fwd {dt:.3f} ms {tf:.1f} TF/s "
                 f"({tf/PEAK*100:.1f}%) | fwd+bwd {dtb:.3f} ms {tfb:.1f} TF/s "
@@ -89,6 +95,40 @@ def main():
             )
         except Exception as e:
             print(f"ffa bq={bq} bk={bk}: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
+
+    # backward-specific tile overrides (fwd pinned at 512x1024): the dq and
+    # dkv kernels have different VMEM/compute profiles, so their best tiles
+    # can differ from fwd's (MAGI_ATTENTION_FFA_BLOCK_*_D{Q,KV})
+    bq, bk = 512, 1024
+    names = (
+        "MAGI_ATTENTION_FFA_BLOCK_Q_DQ", "MAGI_ATTENTION_FFA_BLOCK_K_DQ",
+        "MAGI_ATTENTION_FFA_BLOCK_Q_DKV", "MAGI_ATTENTION_FFA_BLOCK_K_DKV",
+    )
+    for dq_blk, dkv_blk in [
+        ((256, 1024), None),
+        ((1024, 512), None),
+        (None, (256, 1024)),
+        (None, (1024, 512)),
+        ((1024, 512), (1024, 512)),
+    ]:
+        vals = (dq_blk or (None, None)) + (dkv_blk or (None, None))
+        for key, val in zip(names, vals):
+            if val:
+                os.environ[key] = str(val)
+            else:
+                os.environ.pop(key, None)
+        try:
+            dtb, tfb = time_fwd_bwd(bq, bk)
+            print(
+                f"ffa bwd-override dq={dq_blk} dkv={dkv_blk}: fwd+bwd "
+                f"{dtb:.3f} ms {tfb:.1f} TF/s ({tfb/PEAK*100:.1f}%)",
+                flush=True,
+            )
+        except Exception as e:
+            print(f"ffa bwd-override dq={dq_blk} dkv={dkv_blk}: FAIL "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+    for key in names:
+        os.environ.pop(key, None)
 
 
 if __name__ == "__main__":

@@ -2,21 +2,23 @@
 # Persistent TPU experiment poller for flaky chip windows. Never exits on
 # its own — run it in the background and kill it when done.
 #
-# Probes the tunnel TPU every 2 minutes with a short-timeout matmul. On the
-# first responsive window it runs the full experiment queue (smoke -> bench
-# -> block sweep -> profiler trace); afterwards it keeps polling every 30
-# minutes and re-runs bench.py on each later window so .bench_last_tpu.json
-# stays fresh as the kernels improve. All compiles go through the
-# persistent compilation cache (.jax_cache) so later windows -- and the
-# driver's round-end bench -- skip recompiles.
+# Probes the tunnel TPU every 2 minutes with a short-timeout matmul. On
+# every responsive window it runs the experiment queue (smoke -> bench ->
+# block sweep -> 6-mask kernel grid -> profiler trace), logging into
+# timestamped files so each window appends to the history rather than
+# overwriting the last one. Windows are ~10 min, so after a window closes
+# it keeps probing every 2 min (kernels change during the round; every
+# window is worth a re-measure). All compiles go through the persistent
+# compilation cache (.jax_cache) so later windows -- and the driver's
+# round-end bench -- skip recompiles.
 #
-# Logs: .tpu_logs/{queue.log,smoke.log,bench.log,probe.log,profile.log,
-# bench_again.log} (+ the trace protobuf under .tpu_logs/ffa_trace)
+# Logs: .tpu_logs/queue.log + .tpu_logs/<UTC stamp>_{smoke,bench,probe,
+# grid,profile}.log (+ trace protobuf under .tpu_logs/ffa_trace)
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p .tpu_logs
 LOG=.tpu_logs/queue.log
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
-export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
 export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
 
 probe() {
@@ -29,28 +31,37 @@ x.block_until_ready()
 " >> "$LOG" 2>&1
 }
 
+# Re-probe before every step: windows are ~10 min while the queue's serial
+# timeouts total hours — once the chip drops, skip the remaining steps
+# immediately instead of hanging each one to its full timeout (jax calls on
+# the dead tunnel block indefinitely).
+run_step() {  # run_step <timeout> <logfile> <cmd...>
+  local t="$1" log="$2"; shift 2
+  if ! probe; then
+    echo "[$(date -u +%H:%M:%S)] chip dropped — skip $log" >> "$LOG"
+    return 1
+  fi
+  timeout "$t" "$@" > "$log" 2>&1
+  local rc=$?  # capture before the $(...) substitutions below reset $?
+  echo "[$(date -u +%H:%M:%S)] $(basename "$log" .log) rc=$rc" >> "$LOG"
+}
+
+run_queue() {
+  TS=$(date -u +%m%d_%H%M)
+  run_step 900 ".tpu_logs/${TS}_smoke.log" python -u scripts/tpu_smoke.py || return
+  run_step 1500 ".tpu_logs/${TS}_bench.log" python -u bench.py || return
+  run_step 2400 ".tpu_logs/${TS}_probe.log" python -u scripts/tpu_perf_probe.py || return
+  run_step 2400 ".tpu_logs/${TS}_grid.log" python -u benchmarks/kernel_bench.py \
+    --seqlens 4096,8192,32768 --backward || return
+  run_step 1200 ".tpu_logs/${TS}_profile.log" python -u scripts/tpu_profile_ffa.py .tpu_logs/ffa_trace
+}
+
 while true; do
   echo "[$(date -u +%H:%M:%S)] probe" >> "$LOG"
   if probe; then
     echo "[$(date -u +%H:%M:%S)] CHIP UP — running queue" >> "$LOG"
-    timeout 1500 python -u scripts/tpu_smoke.py > .tpu_logs/smoke.log 2>&1
-    echo "[$(date -u +%H:%M:%S)] smoke rc=$?" >> "$LOG"
-    timeout 1800 python -u bench.py > .tpu_logs/bench.log 2>&1
-    echo "[$(date -u +%H:%M:%S)] bench rc=$?" >> "$LOG"
-    timeout 2400 python -u scripts/tpu_perf_probe.py > .tpu_logs/probe.log 2>&1
-    echo "[$(date -u +%H:%M:%S)] perf-probe rc=$?" >> "$LOG"
-    timeout 1200 python -u scripts/tpu_profile_ffa.py .tpu_logs/ffa_trace \
-      > .tpu_logs/profile.log 2>&1
-    echo "[$(date -u +%H:%M:%S)] profile rc=$?" >> "$LOG"
-    echo "QUEUE DONE — continuing to re-bench on later windows" >> "$LOG"
-    while true; do
-      sleep 1800
-      echo "[$(date -u +%H:%M:%S)] re-probe" >> "$LOG"
-      if probe; then
-        timeout 1800 python -u bench.py > .tpu_logs/bench_again.log 2>&1
-        echo "[$(date -u +%H:%M:%S)] re-bench rc=$?" >> "$LOG"
-      fi
-    done
+    run_queue
+    echo "[$(date -u +%H:%M:%S)] QUEUE DONE — resuming probes" >> "$LOG"
   fi
   sleep 120
 done

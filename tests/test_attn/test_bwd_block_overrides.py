@@ -1,0 +1,120 @@
+"""Backward-specific block overrides: numerics identical to the default.
+
+The dq/dkv kernels may run with their own tile sizes
+(MAGI_ATTENTION_FFA_BLOCK_{Q,K}_D{Q,KV}); the tiling must never change the
+math. Incompatible overrides (not dividing the fwd-padded geometry) must
+silently inherit the fwd blocks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.kernels.ffa import ffa_attn
+from magiattention_tpu.testing import assert_close
+
+S, HQ, HK, D = 512, 4, 2, 64
+
+
+def _grads(qr, kr, tm, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.float32)
+
+    def loss(q, k, v):
+        o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=128, block_k=256)
+        return jnp.sum(o * w)
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize(
+    "env",
+    [
+        {"MAGI_ATTENTION_FFA_BLOCK_Q_DQ": "64",
+         "MAGI_ATTENTION_FFA_BLOCK_K_DQ": "128"},
+        {"MAGI_ATTENTION_FFA_BLOCK_Q_DKV": "64",
+         "MAGI_ATTENTION_FFA_BLOCK_K_DKV": "128"},
+        {"MAGI_ATTENTION_FFA_BLOCK_Q_DQ": "256",
+         "MAGI_ATTENTION_FFA_BLOCK_K_DKV": "512"},
+    ],
+)
+def test_override_grads_match_default(monkeypatch, env):
+    qr = np.array([[0, S // 3], [S // 3, S]], np.int32)
+    kr = np.array([[0, S // 3], [0, S]], np.int32)
+    tm = np.array([1, 1], np.int32)
+    ref = _grads(qr, kr, tm)
+    for key, val in env.items():
+        monkeypatch.setenv(key, val)
+    got = _grads(qr, kr, tm)
+    for name, a, b in zip("dq dk dv".split(), got, ref):
+        assert_close(a, b, atol=1e-5, rtol=1e-5, norm_rtol=1e-6,
+                     msg=f"{name} with overrides {env}")
+
+
+def test_cp_runtime_honors_overrides(monkeypatch):
+    """The distributed runtime must apply the same overrides as ffa_attn
+    (ADVICE r3 review: flags silently ignored by the CP path)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from magiattention_tpu import DistAttnConfig, OverlapConfig
+    from magiattention_tpu.api import (
+        calc_attn, dispatch, magi_attn_flex_key, undispatch,
+    )
+    from magiattention_tpu.api.magi_attn_interface import _mgr
+
+    def run():
+        mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("cp",))
+        key = magi_attn_flex_key(
+            [[0, S]], [[0, S]], [1], S, S, mesh=mesh, cp_axis="cp",
+            chunk_size=32,
+            dist_attn_config=DistAttnConfig(
+                overlap_config=OverlapConfig(degree=2)
+            ),
+        )
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.float32)
+        qd = dispatch(q, key)
+        kd = dispatch(k, key, role="kv")
+        vd = dispatch(v, key, role="kv")
+
+        def loss(qd, kd, vd):
+            o, _ = calc_attn(qd, kd, vd, key)
+            return jnp.sum(undispatch(o, key) * w)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(qd, kd, vd)
+        return key, grads
+
+    ref_key, ref = run()
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_BLOCK_Q_DQ", "64")
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_BLOCK_K_DKV", "128")
+    ov_key, got = run()
+    # the env snapshot keys a distinct runtime whose merged plan carries
+    # the override fields
+    assert ov_key != ref_key
+    dims = _mgr(ov_key).runtime._merged_dims
+    assert dims[4], "override fields missing from the merged plan dims"
+    for name, a, b in zip("dq dk dv".split(), got, ref):
+        assert_close(a, b, atol=1e-5, rtol=1e-5, norm_rtol=1e-6,
+                     msg=f"cp {name} with overrides")
+
+
+def test_incompatible_override_inherits(monkeypatch):
+    """Blocks not dividing the padded geometry fall back to fwd blocks."""
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_BLOCK_Q_DQ", "96")  # not /512
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_BLOCK_K_DKV", "192")  # %128 != 0
+    qr = np.array([[0, S]], np.int32)
+    tm = np.array([1], np.int32)
+    ref_env = _grads(qr, qr.copy(), tm)
+    monkeypatch.delenv("MAGI_ATTENTION_FFA_BLOCK_Q_DQ")
+    monkeypatch.delenv("MAGI_ATTENTION_FFA_BLOCK_K_DKV")
+    ref = _grads(qr, qr.copy(), tm)
+    for a, b in zip(ref_env, ref):
+        assert_close(a, b, atol=1e-6, rtol=1e-6, norm_rtol=1e-7)

@@ -88,3 +88,28 @@ def test_native_plan_rejects_out_of_grid(monkeypatch):
     hi = np.array([1 << 30], np.int32)
     with pytest.raises((ValueError, IndexError)):
         _build(monkeypatch, "1", qr, kr, lo, hi, 512, 512, 128, 128)
+
+
+@pytest.mark.parametrize("mode", ["0", "1"])
+@pytest.mark.parametrize(
+    "qr_row,kr_row",
+    [((-64, 128), (0, 128)), ((0, 128), (-64, 128)), ((0, 700), (0, 128))],
+)
+def test_plan_builders_reject_bad_ranges_identically(
+    monkeypatch, mode, qr_row, kr_row
+):
+    """Both builders raise ValueError on negative/out-of-grid starts; the
+    Python fallback must not silently wrap via negative indexing (ADVICE r2)."""
+    if mode == "1":
+        try:
+            from magiattention_tpu.csrc_backend.build import get_lib
+
+            get_lib()
+        except ImportError:
+            pytest.skip("native lib unavailable")
+    qr = np.array([qr_row], np.int32)
+    kr = np.array([kr_row], np.int32)
+    lo = np.array([-1 << 30], np.int32)
+    hi = np.array([1 << 30], np.int32)
+    with pytest.raises(ValueError):
+        _build(monkeypatch, mode, qr, kr, lo, hi, 512, 512, 128, 128)
