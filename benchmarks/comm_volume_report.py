@@ -151,6 +151,17 @@ def report(configs) -> list[dict]:
         uly_bytes_rank = (
             s / cp * D * BYTES * (2 * hq + 2 * HK) * (cp - 1) / cp
         )
+        # loongtrain double ring (O x I): total kv rows moved per rank equal
+        # ring's, but only O-1 of the R-1 hops cross the *outer* (expensive:
+        # inter-node / DCN) axis — the structural claim of the double ring
+        # largest divisor of cp at most cp//4 (floor 2) so O*I == cp exactly
+        lt_o = next(
+            d for d in range(max(2, cp // 4), 1, -1) if cp % d == 0
+        ) if cp % 2 == 0 else 1
+        lt_i = cp // lt_o
+        assert lt_o * lt_i == cp
+        lt_outer_rows = cp * (lt_o - 1) * shard
+        lt_inner_rows = cp * lt_o * (lt_i - 1) * shard
         out.append(
             {
                 "config": name,
@@ -159,6 +170,9 @@ def report(configs) -> list[dict]:
                 "by_alg": by_alg,
                 "ring_gb": gb(ring_rows, cp),
                 "ulysses_gb": uly_bytes_rank / 1e9,
+                "loongtrain_outer_gb": gb(lt_outer_rows, cp),
+                "loongtrain_inner_gb": gb(lt_inner_rows, cp),
+                "loongtrain_shape": (lt_o, lt_i),
             }
         )
     return out
@@ -249,20 +263,25 @@ def main() -> int:
 
     hdr = (
         "| config | seq | dispatch alg | payload | ragged | ppermute | a2a "
-        "| balance | ring/allgather | ulysses |"
+        "| balance | ring/allgather | loongtrain outer+inner | ulysses |"
     )
-    sep = "|" + "---|" * 10
+    sep = "|" + "---|" * 11
     lines = [hdr, sep]
     for r in rows:
         for i, (alg_name, v) in enumerate(r["by_alg"].items()):
             cp = r["cp"]
+            lt = (
+                f"{r['loongtrain_outer_gb']:.3f}+"
+                f"{r['loongtrain_inner_gb']:.3f} "
+                f"({r['loongtrain_shape'][0]}x{r['loongtrain_shape'][1]})"
+            )
             lines.append(
                 f"| {r['config'] if i == 0 else ''} "
                 f"| {r['seqlen'] if i == 0 else ''} | {alg_name} "
                 f"| {gb(v['payload'], cp):.3f} | {gb(v['ragged'], cp):.3f} "
                 f"| {gb(v['pp'], cp):.3f} | {gb(v['a2a'], cp):.3f} "
                 f"| {v['imbalance']:.2f}x "
-                f"| {r['ring_gb']:.3f} | {r['ulysses_gb']:.3f} |"
+                f"| {r['ring_gb']:.3f} | {lt} | {r['ulysses_gb']:.3f} |"
             )
     table = "\n".join(lines)
     print(table)
@@ -290,6 +309,10 @@ def main() -> int:
             " all_to_all padded to the max pair).\n"
             "- **ring/allgather** — every rank receives all non-local KV"
             " regardless of\n  mask: the baselines' mask-independent cost.\n"
+            "- **loongtrain outer+inner** — same total KV rows as ring, but"
+            " the double\n  ring (O x I shown) routes only the outer share"
+            " over the expensive\n  (inter-node / DCN) axis; the inner share"
+            " stays on cheap links.\n"
             "- **ulysses** — head-scatter a2a of q,k,v,o (mask-independent;"
             " cp capped by\n  kv heads = 8 here).\n"
             "- **balance** — max rank attention-area over the mean (1.00 ="

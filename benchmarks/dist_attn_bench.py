@@ -109,10 +109,27 @@ def main() -> int:
             q, k, v, qr, qr, tm, mesh1d)[0])
 
     if "ring" in impls:
-        from magiattention_tpu.parallel.ring import ring_attn
+        from magiattention_tpu.parallel.ring import (
+            ring_attn, ring_attn_allgather, ring_dispatch, ring_undispatch,
+        )
 
-        record("ring", lambda q, k, v: ring_attn(
-            q, k, v, qr, qr, tm, mesh1d)[0])
+        def ring_f(q, k, v):
+            od, _ = ring_attn(
+                ring_dispatch(q, n), ring_dispatch(k, n),
+                ring_dispatch(v, n), qr, qr, tm, mesh1d,
+            )
+            return ring_undispatch(od, n)
+
+        record("ring", ring_f)
+
+        def ring_ag_f(q, k, v):
+            od, _ = ring_attn_allgather(
+                ring_dispatch(q, n), ring_dispatch(k, n),
+                ring_dispatch(v, n), qr, qr, tm, mesh1d,
+            )
+            return ring_undispatch(od, n)
+
+        record("ring_allgather", ring_ag_f)
 
     if "allgather" in impls:
         from magiattention_tpu.parallel.hybrid import allgather_attn
@@ -129,12 +146,22 @@ def main() -> int:
 
     if "loongtrain" in impls:
         from magiattention_tpu.parallel.loongtrain import loongtrain_attn
+        from magiattention_tpu.parallel.ring import (
+            ring_dispatch, ring_undispatch,
+        )
 
         mesh_lt = Mesh(
             devs.reshape(n // 2, 2), axis_names=("rp_out", "rp_in")
         )
-        record("loongtrain", lambda q, k, v: loongtrain_attn(
-            q, k, v, qr, qr, tm, mesh_lt)[0])
+
+        def lt_f(q, k, v):
+            od, _ = loongtrain_attn(
+                ring_dispatch(q, n), ring_dispatch(k, n),
+                ring_dispatch(v, n), qr, qr, tm, mesh_lt,
+            )
+            return ring_undispatch(od, n)
+
+        record("loongtrain", lt_f)
 
     if "hybrid" in impls:
         from magiattention_tpu.parallel.hybrid import hybrid_cp_attn

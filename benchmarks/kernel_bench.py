@@ -104,6 +104,11 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from magiattention_tpu.benchmarking.perf_report import (
+        HW_FWD_BWD_RATIO,
+        append_row,
+        history_report,
+    )
     from magiattention_tpu.kernels.ffa import ffa_attn
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
@@ -163,13 +168,32 @@ def main() -> int:
                     row["fwdbwd_tflops"] = round(
                         flops * 3.5 / (dtb * 1e-3) / 1e12, 2
                     )
+                    # hardware matmul convention (bwd = 3.5x fwd on TPU)
+                    row["fwdbwd_mfu"] = round(
+                        row["fwdbwd_tflops"] / peak, 4
+                    )
+                    row["fwdbwd_mfu_hw"] = round(
+                        row["fwdbwd_tflops"] * HW_FWD_BWD_RATIO / peak, 4
+                    )
                 rows.append(row)
                 print(json.dumps(row), flush=True)
+                if jax.default_backend() == "tpu":
+                    append_row("kernel_grid", {
+                        "mask": name, "seqlen": s, "dtype": args.dtype,
+                        **{kk: vv for kk, vv in row.items()
+                           if kk not in ("mask", "seqlen")},
+                    })
             except Exception as e:  # noqa: BLE001
                 print(json.dumps({
                     "mask": name, "seqlen": s,
                     "error": f"{type(e).__name__}: {e}"[:160],
                 }), flush=True)
+    if jax.default_backend() == "tpu":
+        report = history_report(
+            "kernel_grid", ["mask", "seqlen", "dtype"], "fwd_tflops"
+        )
+        if report:
+            print(report, flush=True)
     return 0
 
 

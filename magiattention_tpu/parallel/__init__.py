@@ -7,7 +7,12 @@ kernel and XLA collectives.
 """
 
 from .ulysses import ulysses_attn  # noqa: F401
-from .ring import ring_attn  # noqa: F401
+from .ring import (  # noqa: F401
+    ring_attn,
+    ring_attn_allgather,
+    ring_dispatch,
+    ring_undispatch,
+)
 from .usp import usp_attn  # noqa: F401
-from .loongtrain import loongtrain_attn  # noqa: F401
+from .loongtrain import loongtrain_attn, make_loongtrain_mesh  # noqa: F401
 from .hybrid import allgather_attn, hybrid_cp_attn  # noqa: F401

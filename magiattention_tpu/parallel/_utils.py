@@ -47,6 +47,109 @@ def ring_step_plans(qr, kr, lo, hi, shard: int, n: int, bq: int, bk: int):
     return plans
 
 
+def zigzag_perm(S: int, cp: int) -> np.ndarray:
+    """Global row permutation for zigzag sharding (ref
+    exps/dist_attn/baselines/shard.py:486 generate_zigzag_dispatch_indices):
+    the sequence splits into ``2*cp`` equal chunks and rank r owns chunks
+    ``r`` and ``2*cp-1-r`` — the classic causal load-balance layout (every
+    rank computes the same attention area). ``perm[i]`` is the natural-order
+    row stored at zigzag position ``i``; sharding the permuted array with
+    ``P(cp_axis)`` hands each rank its two chunks."""
+    if S % (2 * cp):
+        raise ValueError(f"zigzag needs seqlen % (2*cp) == 0, got {S} % {2*cp}")
+    c = S // (2 * cp)
+    order = []
+    for r in range(cp):
+        order += [r, 2 * cp - 1 - r]
+    return np.concatenate(
+        [np.arange(ch * c, (ch + 1) * c, dtype=np.int64) for ch in order]
+    )
+
+
+def zigzag_inv_perm(S: int, cp: int) -> np.ndarray:
+    """Inverse of :func:`zigzag_perm` (zigzag position of each natural row)."""
+    perm = zigzag_perm(S, cp)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=np.int64)
+    return inv
+
+
+def check_zigzag_geometry(shard: int, n: int) -> None:
+    """Plans assume each rank owns two equal chunks: shard must be even
+    (seqlen % (2*n) == 0). Without this check an odd shard silently
+    truncates (c = shard // 2) and the plans misalign to the mask."""
+    if shard % 2:
+        raise ValueError(
+            f"zigzag sharding needs an even per-rank shard "
+            f"(seqlen % {2 * n} == 0), got shard={shard}"
+        )
+
+
+def zigzag_segs(rank: int, cp: int, chunk: int) -> list[tuple[int, int, int]]:
+    """The two global segments rank owns under zigzag sharding, as
+    ``(gstart, gend, local_offset)`` rows-of-``chunk`` pairs."""
+    return [
+        (rank * chunk, (rank + 1) * chunk, 0),
+        ((2 * cp - 1 - rank) * chunk, (2 * cp - rank) * chunk, chunk),
+    ]
+
+
+def clip_to_segs(
+    q_ranges, k_ranges, d_lo, d_hi,
+    q_segs: list[tuple[int, int, int]],
+    k_segs: list[tuple[int, int, int]],
+) -> np.ndarray:
+    """Clip global band slices to every (q_seg, k_seg) pair of possibly
+    non-contiguous ownership (zigzag), shifting to buffer-local coordinates
+    via each segment's local offset. Returns ``(n, 6)`` int64 local slices."""
+    out = []
+    for q0, q1, qoff in q_segs:
+        for k0, k1, koff in k_segs:
+            # local ql = g - q0 + qoff, kl = g - k0 + koff; the band
+            # j - i >= lo becomes kl - ql >= lo + (q0 - qoff) - (k0 - koff)
+            shift = (q0 - qoff) - (k0 - koff)
+            for i in range(len(q_ranges)):
+                qs = max(int(q_ranges[i, 0]), q0)
+                qe = min(int(q_ranges[i, 1]), q1)
+                ks = max(int(k_ranges[i, 0]), k0)
+                ke = min(int(k_ranges[i, 1]), k1)
+                if qs >= qe or ks >= ke:
+                    continue
+                lo, hi = int(d_lo[i]), int(d_hi[i])
+                lo_l = lo if lo <= -BAND_INF else lo + shift
+                hi_l = hi if hi >= BAND_INF else hi + shift
+                out.append((
+                    qs - q0 + qoff, qe - q0 + qoff,
+                    ks - k0 + koff, ke - k0 + koff,
+                    lo_l, hi_l,
+                ))
+    return np.asarray(out, dtype=np.int64).reshape(-1, 6)
+
+
+def zigzag_ring_step_plans(
+    qr, kr, lo, hi, shard: int, n: int, bq: int, bk: int,
+    ring_rank_of=None,
+):
+    """``plans[step][rank]`` for an n-rank KV ring under zigzag sharding:
+    both q and the visiting kv buffer hold their owner's two zigzag chunks.
+    ``ring_rank_of`` maps a flat rank to its ring rank (identity for the
+    plain ring; the double-ring visiting order for LoongTrain)."""
+    check_zigzag_geometry(shard, n)
+    c = shard // 2
+    plans = []
+    for s in range(n):
+        per_rank = []
+        for r in range(n):
+            src = ring_rank_of(r, s) if ring_rank_of else (r - s) % n
+            slices = clip_to_segs(
+                qr, kr, lo, hi,
+                zigzag_segs(r, n, c), zigzag_segs(src, n, c),
+            )
+            per_rank.append(block_plan(slices, shard, shard, bq, bk))
+        plans.append(per_rank)
+    return plans
+
+
 def clip_to_blocks(
     q_ranges, k_ranges, d_lo, d_hi, q0, q1, k0, k1
 ) -> np.ndarray:

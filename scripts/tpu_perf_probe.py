@@ -22,6 +22,11 @@ import numpy as np
 
 PEAK = 197.0  # v5e bf16 TFLOP/s
 
+from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
+    HW_FWD_BWD_RATIO as HW_RATIO,
+    append_row,
+)
+
 
 def scan_time(body, init, length=8, reps=3):
     """ms per body() call, chained through the carry."""
@@ -91,8 +96,16 @@ def main():
             print(
                 f"ffa bq={bq} bk={bk}: fwd {dt:.3f} ms {tf:.1f} TF/s "
                 f"({tf/PEAK*100:.1f}%) | fwd+bwd {dtb:.3f} ms {tfb:.1f} TF/s "
-                f"({tfb/PEAK*100:.1f}%)", flush=True,
+                f"({tfb/PEAK*100:.1f}%, hw {tfb*HW_RATIO/PEAK*100:.1f}%)",
+                flush=True,
             )
+            append_row("block_sweep", {
+                "block_q": bq, "block_k": bk,
+                "fwd_ms": round(dt, 3), "fwd_tflops": round(tf, 2),
+                "fwdbwd_ms": round(dtb, 3), "fwdbwd_tflops": round(tfb, 2),
+                "fwdbwd_mfu": round(tfb / PEAK, 4),
+                "fwdbwd_mfu_hw": round(tfb * HW_RATIO / PEAK, 4),
+            })
         except Exception as e:
             print(f"ffa bq={bq} bk={bk}: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
 
@@ -124,6 +137,11 @@ def main():
                 f"{dtb:.3f} ms {tfb:.1f} TF/s ({tfb/PEAK*100:.1f}%)",
                 flush=True,
             )
+            append_row("bwd_override_sweep", {
+                "dq_blocks": str(dq_blk), "dkv_blocks": str(dkv_blk),
+                "fwdbwd_ms": round(dtb, 3), "fwdbwd_tflops": round(tfb, 2),
+                "fwdbwd_mfu": round(tfb / PEAK, 4),
+            })
         except Exception as e:
             print(f"ffa bwd-override dq={dq_blk} dkv={dkv_blk}: FAIL "
                   f"{type(e).__name__}: {str(e)[:200]}", flush=True)

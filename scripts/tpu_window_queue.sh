@@ -54,6 +54,8 @@ run_queue() {
   run_step 2400 ".tpu_logs/${TS}_grid.log" python -u benchmarks/kernel_bench.py \
     --seqlens 4096,8192,32768 --backward || return
   run_step 1200 ".tpu_logs/${TS}_profile.log" python -u scripts/tpu_profile_ffa.py .tpu_logs/ffa_trace
+  # unproven-on-silicon step last so its failure can't cost the trace
+  run_step 900 ".tpu_logs/${TS}_overlap.log" python -u scripts/tpu_overlap_tax.py
 }
 
 while true; do

@@ -13,7 +13,11 @@ from magiattention_tpu.parallel import (
     allgather_attn,
     hybrid_cp_attn,
     loongtrain_attn,
+    make_loongtrain_mesh,
     ring_attn,
+    ring_attn_allgather,
+    ring_dispatch,
+    ring_undispatch,
     ulysses_attn,
     usp_attn,
 )
@@ -67,15 +71,81 @@ def test_ulysses_forward(case):
     assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
 
 
+@pytest.mark.parametrize("sharding", ["contig", "zigzag"])
 @pytest.mark.parametrize("case", sorted(CASES))
-def test_ring_forward(case):
+def test_ring_forward(case, sharding):
     mesh, q, k, v, qr, kr, tm, mask = setup(case)
-    out, lse = jax.jit(
-        lambda q, k, v: ring_attn(q, k, v, qr, kr, tm, mesh)
-    )(q, k, v)
+
+    def run(q, k, v):
+        qd = ring_dispatch(q, CP, sharding)
+        kd = ring_dispatch(k, CP, sharding)
+        vd = ring_dispatch(v, CP, sharding)
+        out_d, lse_d = ring_attn(
+            qd, kd, vd, qr, kr, tm, mesh, sharding=sharding
+        )
+        return (
+            ring_undispatch(out_d, CP, sharding),
+            ring_undispatch(lse_d, CP, sharding),
+        )
+
+    out, lse = jax.jit(run)(q, k, v)
     out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
     assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
     assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+@pytest.mark.parametrize("sharding", ["contig", "zigzag"])
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_ring_allgather_forward(case, sharding):
+    """The reference's RingAttnAllGather variant (one up-front KV gather)."""
+    mesh, q, k, v, qr, kr, tm, mask = setup(case)
+
+    def run(q, k, v):
+        qd = ring_dispatch(q, CP, sharding)
+        kd = ring_dispatch(k, CP, sharding)
+        vd = ring_dispatch(v, CP, sharding)
+        out_d, lse_d = ring_attn_allgather(
+            qd, kd, vd, qr, kr, tm, mesh, sharding=sharding
+        )
+        return (
+            ring_undispatch(out_d, CP, sharding),
+            ring_undispatch(lse_d, CP, sharding),
+        )
+
+    out, lse = jax.jit(run)(q, k, v)
+    out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+    assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+def test_zigzag_balances_causal_area():
+    """The point of zigzag sharding: every rank computes the same causal
+    area (contig sharding is maximally imbalanced)."""
+    from magiattention_tpu.parallel._utils import (
+        band_meta, zigzag_segs, clip_to_segs,
+    )
+    from magiattention_tpu.meta.container.slice import band_area_batch
+
+    qr, kr, tm = np.array([[0, S]]), np.array([[0, S]]), np.array([1])
+    qrb, krb, lo, hi = band_meta(qr, kr, tm)
+    shard = S // CP
+    areas = []
+    for r in range(CP):
+        total = 0
+        for s in range(CP):
+            sl = clip_to_segs(
+                qrb, krb, lo, hi,
+                zigzag_segs(r, CP, shard // 2),
+                zigzag_segs((r - s) % CP, CP, shard // 2),
+            )
+            if len(sl):
+                total += int(band_area_batch(
+                    sl[:, 0], sl[:, 1], sl[:, 2], sl[:, 3],
+                    sl[:, 4], sl[:, 5],
+                ).sum())
+        areas.append(total)
+    assert len(set(areas)) == 1, f"zigzag areas not balanced: {areas}"
+    assert sum(areas) == S * (S + 1) // 2
 
 
 def setup_2d(case, ax_names, shape=(2, 2), seed=0):
@@ -93,14 +163,63 @@ def test_usp_forward(case):
     assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
 
 
+@pytest.mark.parametrize("sharding", ["contig", "zigzag"])
 @pytest.mark.parametrize("case", sorted(CASES))
-def test_loongtrain_forward(case):
+def test_loongtrain_forward(case, sharding):
     mesh, q, k, v, qr, kr, tm, mask = setup_2d(
         case, ("rp_out", "rp_in"), shape=(2, 4)
     )
-    out, lse = jax.jit(
-        lambda q, k, v: loongtrain_attn(q, k, v, qr, kr, tm, mesh)
-    )(q, k, v)
+    R = 8
+
+    def run(q, k, v):
+        qd = ring_dispatch(q, R, sharding)
+        kd = ring_dispatch(k, R, sharding)
+        vd = ring_dispatch(v, R, sharding)
+        out_d, lse_d = loongtrain_attn(
+            qd, kd, vd, qr, kr, tm, mesh, sharding=sharding
+        )
+        return (
+            ring_undispatch(out_d, R, sharding),
+            ring_undispatch(lse_d, R, sharding),
+        )
+
+    out, lse = jax.jit(run)(q, k, v)
+    out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+    assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+@pytest.mark.parametrize("placement", ["head_first", "context_first"])
+def test_loongtrain_2d_attention(placement):
+    """2D attention (ulysses head axis x double ring) under both rank
+    placements (ref LoongTrain's ULYSESS + INTRA/INTER_WINDOW groups)."""
+    case = "causal"
+    qr, kr, tm = (np.array(x) for x in CASES[case])
+    mesh = make_loongtrain_mesh(
+        jax.devices("cpu")[:8], ulysses=2, outer=2, inner=2,
+        placement=placement,
+    )
+    R = 4
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), dtype=jnp.float32)
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr.tolist()), AttnRanges.from_ranges(kr.tolist()),
+        [AttnMaskType.from_int_type(t) for t in tm.tolist()],
+        total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+
+    def run(q, k, v):
+        qd = ring_dispatch(q, R)
+        kd = ring_dispatch(k, R)
+        vd = ring_dispatch(v, R)
+        out_d, lse_d = loongtrain_attn(
+            qd, kd, vd, qr, kr, tm, mesh, ulysses_axis="sp"
+        )
+        return ring_undispatch(out_d, R), ring_undispatch(lse_d, R)
+
+    out, lse = jax.jit(run)(q, k, v)
     out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
     assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
     assert_close(lse, lse_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
@@ -146,7 +265,13 @@ def test_more_backward(which):
         mesh, q, k, v, qr, kr, tm, mask = setup_2d(
             "causal", ("rp_out", "rp_in"), shape=(2, 4)
         )
-        attn = lambda q, k, v: loongtrain_attn(q, k, v, qr, kr, tm, mesh)
+
+        def attn(q, k, v):
+            out_d, lse_d = loongtrain_attn(
+                ring_dispatch(q, 8), ring_dispatch(k, 8),
+                ring_dispatch(v, 8), qr, kr, tm, mesh,
+            )
+            return ring_undispatch(out_d, 8), ring_undispatch(lse_d, 8)
     else:
         mesh, q, k, v, qr, kr, tm, mask = setup("causal")
         attn = lambda q, k, v: allgather_attn(q, k, v, qr, kr, tm, mesh)
@@ -167,14 +292,21 @@ def test_more_backward(which):
         assert_close(a, b, atol=1e-3, rtol=1e-3, norm_rtol=3e-4, msg=name)
 
 
-def test_ring_backward():
+@pytest.mark.parametrize("variant", ["p2p", "allgather"])
+@pytest.mark.parametrize("sharding", ["contig", "zigzag"])
+def test_ring_backward(variant, sharding):
     mesh, q, k, v, qr, kr, tm, mask = setup("causal")
     rng = np.random.default_rng(9)
     w = jnp.asarray(rng.standard_normal((S, HQ, D)), dtype=jnp.float32)
+    fn = ring_attn if variant == "p2p" else ring_attn_allgather
 
     def loss(q, k, v):
-        out, _ = ring_attn(q, k, v, qr, kr, tm, mesh)
-        return jnp.sum(out * w)
+        out_d, _ = fn(
+            ring_dispatch(q, CP, sharding), ring_dispatch(k, CP, sharding),
+            ring_dispatch(v, CP, sharding), qr, kr, tm, mesh,
+            sharding=sharding,
+        )
+        return jnp.sum(ring_undispatch(out_d, CP, sharding) * w)
 
     def loss_ref(q, k, v):
         out, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)

@@ -1,0 +1,42 @@
+"""perf-history CSV: append semantics, schema evolution, delta report."""
+
+import csv
+
+import pytest
+
+import magiattention_tpu.benchmarking.perf_report as pr
+
+
+@pytest.fixture()
+def history_dir(tmp_path, monkeypatch):
+    monkeypatch.setattr(pr, "HISTORY_DIR", str(tmp_path))
+    return tmp_path
+
+
+def test_append_and_report(history_dir):
+    pr.append_row("k", {"mask": "causal", "seqlen": 4096, "tflops": 10.0})
+    pr.append_row("k", {"mask": "causal", "seqlen": 4096, "tflops": 25.0})
+    pr.append_row("k", {"mask": "video", "seqlen": 4096, "tflops": 40.0})
+    path = history_dir / "k.csv"
+    rows = list(csv.DictReader(open(path)))
+    assert len(rows) == 3
+    assert all(r["utc"] and r["commit"] for r in rows)
+    report = pr.history_report("k", ["mask", "seqlen"], "tflops")
+    assert "causal/4096" in report and "+150.0%" in report
+    assert "video/4096" in report
+
+
+def test_schema_evolution_rewrites_header(history_dir):
+    pr.append_row("k", {"a": 1})
+    pr.append_row("k", {"a": 2, "b": 3})  # new column
+    rows = list(csv.DictReader(open(history_dir / "k.csv")))
+    assert rows[0]["b"] == "" and rows[1]["b"] == "3"
+
+
+def test_report_without_history_is_empty(history_dir):
+    assert pr.history_report("missing", ["x"], "y") == ""
+
+
+def test_append_never_raises(history_dir, monkeypatch):
+    monkeypatch.setattr(pr, "HISTORY_DIR", "/proc/definitely/not/writable")
+    assert pr.append_row("k", {"a": 1}) == ""
