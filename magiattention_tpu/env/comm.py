@@ -34,7 +34,14 @@ def is_ragged_grpcoll_enable() -> bool:
     """Use ``jax.lax.ragged_all_to_all`` for GroupCast — true per-pair split
     sizes, zero padding on the wire (the TPU counterpart of the reference's
     native grpcoll kernel tier, csrc/comm/grpcoll/). Default: auto — on when
-    the backend supports the op (TPU), off on CPU (XLA:CPU lacks it)."""
+    the backend supports the op (TPU), off on CPU (XLA:CPU lacks it).
+
+    The auto branch NEVER forces backend initialization: this is consulted
+    at *planning* time (solver pick_lowering), and host-side planning
+    scripts with no devices must not block on (possibly hung) TPU plugin
+    init. If no backend is initialized yet, auto resolves to the portable
+    tiers; every real execution flow builds a Mesh of live devices first,
+    so the backend is initialized by the time plans are made there."""
     import os
 
     v = os.environ.get("MAGI_ATTENTION_RAGGED_GRPCOLL", "auto").lower()
@@ -42,6 +49,13 @@ def is_ragged_grpcoll_enable() -> bool:
         return True
     if v in ("0", "false", "off"):
         return False
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:  # not initialized — stay portable
+            return False
+    except Exception:  # private-API drift: fall through to the safe query
+        pass
     import jax
 
     try:
