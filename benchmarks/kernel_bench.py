@@ -153,17 +153,27 @@ def main() -> int:
                     "fwd_mfu": round(flops / (dt * 1e-3) / 1e12 / peak, 4),
                 }
                 if args.backward:
-                    def loss(qq):
-                        o, _ = ffa_attn(qq, k, v, qr, kr, tm)
+                    def loss(qq, kk, vv):
+                        o, _ = ffa_attn(qq, kk, vv, qr, kr, tm)
                         return jnp.sum(
                             o.astype(jnp.float32) * w.astype(jnp.float32)
                         )
 
-                    g = jax.grad(loss)
-                    dtb = scan_time(
-                        lambda qq: (qq + 1e-3 * g(qq).astype(dtype)).astype(dtype),
-                        q0,
-                    )
+                    # all three grads must feed the timed carry: dk/dv come
+                    # from a separate pallas_call that XLA dead-code-
+                    # eliminates if unused (it silently halves the measured
+                    # backward work — caught on silicon when fwd+bwd timed
+                    # faster than fwd)
+                    g = jax.grad(loss, argnums=(0, 1, 2))
+
+                    def bwd_body(qq):
+                        dq, dk, dv = g(qq, k, v)
+                        kv_touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
+                        return (
+                            qq + 1e-3 * dq.astype(dtype) + kv_touch.astype(dtype)
+                        ).astype(dtype)
+
+                    dtb = scan_time(bwd_body, q0)
                     row["fwdbwd_ms"] = round(dtb, 3)
                     row["fwdbwd_tflops"] = round(
                         flops * 3.5 / (dtb * 1e-3) / 1e12, 2

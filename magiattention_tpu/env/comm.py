@@ -54,8 +54,12 @@ def is_ragged_grpcoll_enable() -> bool:
 
         if not xla_bridge._backends:  # not initialized — stay portable
             return False
-    except Exception:  # private-API drift: fall through to the safe query
-        pass
+    except Exception:
+        # private-API drift: jax.default_backend() below is only
+        # exception-safe, not init-safe — it would force (possibly hung)
+        # TPU plugin init from a host-side planning script, the exact
+        # regression the _backends probe exists to prevent. Stay portable.
+        return False
     import jax
 
     try:

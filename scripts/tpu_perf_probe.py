@@ -82,10 +82,18 @@ def main():
             return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
 
         g = jax.grad(loss, argnums=(0, 1, 2))
-        dtb = scan_time(
-            lambda q: (q + 1e-3 * g(q, k, v)[0].astype(jnp.bfloat16)).astype(jnp.bfloat16),
-            q0, length=6, reps=2,
-        )
+
+        def body(q):
+            # consume ALL grads — an unused dk/dv lets XLA DCE the whole dkv
+            # pallas_call and the "fwd+bwd" timing quietly drops to fwd+dq
+            # (caught on silicon: fwd+bwd < fwd at bq=bk=1024)
+            dq, dk, dv = g(q, k, v)
+            kv_touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
+            return (
+                q + 1e-3 * dq.astype(jnp.bfloat16) + kv_touch.astype(jnp.bfloat16)
+            ).astype(jnp.bfloat16)
+
+        dtb = scan_time(body, q0, length=6, reps=2)
         return dtb, 4 * area * D * HQ * 3.5 / (dtb * 1e-3) / 1e12
 
     for bq, bk in [(256, 512), (512, 512), (512, 1024), (1024, 512),
