@@ -54,7 +54,10 @@ def run_worker() -> int:
 
     import jax.numpy as jnp
 
-    from magiattention_tpu.benchmarking.bench import do_bench_scan
+    from magiattention_tpu.benchmarking.bench import (
+        do_bench_scan,
+        make_consume_all_grads_body,
+    )
     from magiattention_tpu.kernels.ffa import ffa_attn
 
     S, HQ, HK, D = 4096, 16, 8, 128
@@ -97,17 +100,7 @@ def run_worker() -> int:
             return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
 
         grad = jax.grad(loss, argnums=(0, 1, 2))
-
-        def body(q):
-            # consume ALL grads: dk/dv come from a separate pallas_call that
-            # XLA dead-code-eliminates if unused, silently dropping ~60% of
-            # the backward work from the measurement (caught on silicon when
-            # fwd+bwd timed faster than fwd alone)
-            dq, dk, dv = grad(q, k, v)
-            kv_touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
-            return (q + 1e-3 * dq.astype(dtype) + kv_touch.astype(dtype)).astype(dtype)
-
-        return body
+        return make_consume_all_grads_body(lambda q: grad(q, k, v), dtype)
 
     timing_mode = "scan"
     sweep_error = None

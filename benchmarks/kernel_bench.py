@@ -104,6 +104,9 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from magiattention_tpu.benchmarking.bench import (
+        make_consume_all_grads_body,
+    )
     from magiattention_tpu.benchmarking.perf_report import (
         HW_FWD_BWD_RATIO,
         append_row,
@@ -115,20 +118,13 @@ def main() -> int:
     HQ, HK, D = args.heads, args.kv_heads, args.head_dim
     peak = 197.0
 
-    def scan_time(body, init, length=6, reps=2):
-        @jax.jit
-        def run(x):
-            return jax.lax.scan(
-                lambda c, _: (body(c), None), x, None, length=length
-            )[0]
+    from magiattention_tpu.benchmarking.bench import do_bench_scan
 
-        jax.block_until_ready(run(init))
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.block_until_ready(run(init))
-            best = min(best, time.perf_counter() - t0)
-        return best / length * 1e3
+    def scan_time(body, init, length=6, reps=2):
+        # do_bench_scan forces a value fetch after block_until_ready —
+        # required on the tunneled backend, where block_until_ready alone
+        # can return before remote execution completes
+        return do_bench_scan(body, init, length=length, reps=reps)
 
     rows = []
     rng = np.random.default_rng(0)
@@ -159,20 +155,10 @@ def main() -> int:
                             o.astype(jnp.float32) * w.astype(jnp.float32)
                         )
 
-                    # all three grads must feed the timed carry: dk/dv come
-                    # from a separate pallas_call that XLA dead-code-
-                    # eliminates if unused (it silently halves the measured
-                    # backward work — caught on silicon when fwd+bwd timed
-                    # faster than fwd)
                     g = jax.grad(loss, argnums=(0, 1, 2))
-
-                    def bwd_body(qq):
-                        dq, dk, dv = g(qq, k, v)
-                        kv_touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
-                        return (
-                            qq + 1e-3 * dq.astype(dtype) + kv_touch.astype(dtype)
-                        ).astype(dtype)
-
+                    bwd_body = make_consume_all_grads_body(
+                        lambda qq, k=k, v=v: g(qq, k, v), dtype
+                    )
                     dtb = scan_time(bwd_body, q0)
                     row["fwdbwd_ms"] = round(dtb, 3)
                     row["fwdbwd_tflops"] = round(

@@ -86,6 +86,29 @@ def do_bench_scan(
     return best
 
 
+def make_consume_all_grads_body(grad_fn, dtype):
+    """Timing body ``q -> q`` that consumes ALL of (dq, dk, dv).
+
+    Load-bearing anti-DCE measurement logic: dk/dv come from a separate
+    pallas_call that XLA dead-code-eliminates when unused, silently
+    dropping ~60% of the backward from the measured program (caught on
+    silicon when fwd+bwd timed faster than fwd alone). Every fwd+bwd
+    timing harness must build its body through this ONE helper.
+
+    ``grad_fn(q) -> (dq, dk, dv)``; dk/dv enter the carry as a 1e-30-scaled
+    scalar — numerically invisible, but a real data dependence XLA cannot
+    fold away (mul-by-zero would be simplifiable; 1e-30 is not).
+    """
+    import jax.numpy as jnp
+
+    def body(q):
+        dq, dk, dv = grad_fn(q)
+        touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
+        return (q + 1e-3 * dq.astype(dtype) + touch.astype(dtype)).astype(dtype)
+
+    return body
+
+
 @dataclass
 class Benchmark:
     """Declarative sweep spec (ref Benchmark/Mark :372)."""

@@ -30,7 +30,10 @@ except Exception:
 import jax.numpy as jnp
 import numpy as np
 
-from magiattention_tpu.benchmarking.bench import do_bench_scan  # noqa: E402
+from magiattention_tpu.benchmarking.bench import (  # noqa: E402
+    do_bench_scan,
+    make_consume_all_grads_body,
+)
 from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
     HW_FWD_BWD_RATIO,
     append_row,
@@ -111,11 +114,6 @@ def main():
     # -- 2. bundled flash_attention vs our FFA, same shape ----------------
     # dense causal, equal heads (the bundled kernel has no GQA): the kernel-
     # efficiency A/B. FLOPs by causal area, identical for both.
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        BlockSizes,
-        flash_attention,
-    )
-
     from magiattention_tpu.kernels.ffa import ffa_attn
 
     S, H, D = 4096, 16, 128
@@ -153,7 +151,42 @@ def main():
         except Exception as e:
             print(f"{tag}: FAIL {type(e).__name__}: {str(e)[:300]}", flush=True)
 
-    # bundled kernel: default block sizes
+    # our FFA on the dense-causal problem FIRST (seq-major layout, H==HK):
+    # it must be measured even if the bundled-kernel module is missing
+    qs = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    ks = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    vs = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    ws = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    qr = np.array([[0, S]], np.int32)
+    kr = np.array([[0, S]], np.int32)
+    tm = np.array([1], np.int32)
+
+    for bq, bk in [(256, 512), (512, 512)]:
+        def ffa_fwd(q, bq=bq, bk=bk):
+            return ffa_attn(q, ks, vs, qr, kr, tm, block_q=bq, block_k=bk)[0].astype(jnp.bfloat16)
+
+        def ffa_loss(q, k, v, bq=bq, bk=bk):
+            o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) * ws.astype(jnp.float32))
+
+        ffa_g = jax.grad(ffa_loss, argnums=(0, 1, 2))
+        ffa_step = make_consume_all_grads_body(
+            lambda q, g=ffa_g: g(q, ks, vs), jnp.bfloat16
+        )
+        run_ab(f"ffa_bq{bq}_bk{bk}", ffa_fwd, ffa_step, qs)
+
+    # bundled kernel (guarded: jax.experimental churns — its absence must
+    # not cost the FFA measurements above or abort a scarce chip window)
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes,
+            flash_attention,
+        )
+    except Exception as e:
+        print(f"bundled flash_attention unavailable: {type(e).__name__}: "
+              f"{str(e)[:160]}", flush=True)
+        return
+
     def bundled_fwd(q):
         return flash_attention(q, kb, vb, causal=True).astype(jnp.bfloat16)
 
@@ -162,13 +195,9 @@ def main():
         return jnp.sum(o.astype(jnp.float32) * wb.astype(jnp.float32))
 
     bundled_g = jax.grad(bundled_loss, argnums=(0, 1, 2))
-
-    def bundled_step(q):
-        # consume all grads or XLA DCEs the dkv kernel out of the timing
-        dq, dk, dv = bundled_g(q, kb, vb)
-        touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
-        return (q + 1e-3 * dq.astype(jnp.bfloat16) + touch.astype(jnp.bfloat16)).astype(jnp.bfloat16)
-
+    bundled_step = make_consume_all_grads_body(
+        lambda q: bundled_g(q, kb, vb), jnp.bfloat16
+    )
     run_ab("bundled_flash", bundled_fwd, bundled_step, qb)
 
     # bundled kernel with our winning block sizes, for tile parity
@@ -188,41 +217,12 @@ def main():
             return jnp.sum(o.astype(jnp.float32) * wb.astype(jnp.float32))
 
         bundled_gb = jax.grad(bundled_loss_b, argnums=(0, 1, 2))
-
-        def bundled_step_b(q):
-            dq, dk, dv = bundled_gb(q, kb, vb)
-            touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
-            return (q + 1e-3 * dq.astype(jnp.bfloat16) + touch.astype(jnp.bfloat16)).astype(jnp.bfloat16)
-
+        bundled_step_b = make_consume_all_grads_body(
+            lambda q: bundled_gb(q, kb, vb), jnp.bfloat16
+        )
         run_ab("bundled_flash_b512", bundled_fwd_b, bundled_step_b, qb)
     except Exception as e:
         print(f"bundled_flash_b512: skip {type(e).__name__}: {str(e)[:160]}", flush=True)
-
-    # our FFA on the same dense-causal problem (seq-major layout, H==HK)
-    qs = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
-    ks = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
-    vs = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
-    ws = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
-    qr = np.array([[0, S]], np.int32)
-    kr = np.array([[0, S]], np.int32)
-    tm = np.array([1], np.int32)
-
-    for bq, bk in [(256, 512), (512, 512)]:
-        def ffa_fwd(q, bq=bq, bk=bk):
-            return ffa_attn(q, ks, vs, qr, kr, tm, block_q=bq, block_k=bk)[0].astype(jnp.bfloat16)
-
-        def ffa_loss(q, k, v, bq=bq, bk=bk):
-            o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=bq, block_k=bk)
-            return jnp.sum(o.astype(jnp.float32) * ws.astype(jnp.float32))
-
-        ffa_g = jax.grad(ffa_loss, argnums=(0, 1, 2))
-
-        def ffa_step(q, g=ffa_g):
-            dq, dk, dv = g(q, ks, vs)
-            touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
-            return (q + 1e-3 * dq.astype(jnp.bfloat16) + touch.astype(jnp.bfloat16)).astype(jnp.bfloat16)
-
-        run_ab(f"ffa_bq{bq}_bk{bk}", ffa_fwd, ffa_step, qs)
 
 
 if __name__ == "__main__":

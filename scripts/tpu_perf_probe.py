@@ -28,22 +28,20 @@ from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
 )
 
 
+from magiattention_tpu.benchmarking.bench import (  # noqa: E402
+    do_bench_scan,
+    make_consume_all_grads_body,
+)
+
+
 def scan_time(body, init, length=8, reps=3):
-    """ms per body() call, chained through the carry."""
-
-    @jax.jit
-    def run(x):
-        return jax.lax.scan(lambda c, _: (body(c), None), x, None, length=length)[0]
-
+    """ms per body() call, chained through the carry. do_bench_scan forces
+    a value fetch after block_until_ready — required on the tunneled
+    backend, where block_until_ready alone can return early."""
     t0 = time.perf_counter()
-    jax.block_until_ready(run(init))
-    print(f"  [compile+first {time.perf_counter()-t0:.0f}s]", flush=True)
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(run(init))
-        best = min(best, time.perf_counter() - t0)
-    return best / length * 1e3
+    ms = do_bench_scan(body, init, length=length, reps=reps)
+    print(f"  [total incl compile {time.perf_counter()-t0:.0f}s]", flush=True)
+    return ms
 
 
 def main():
@@ -82,17 +80,9 @@ def main():
             return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
 
         g = jax.grad(loss, argnums=(0, 1, 2))
-
-        def body(q):
-            # consume ALL grads — an unused dk/dv lets XLA DCE the whole dkv
-            # pallas_call and the "fwd+bwd" timing quietly drops to fwd+dq
-            # (caught on silicon: fwd+bwd < fwd at bq=bk=1024)
-            dq, dk, dv = g(q, k, v)
-            kv_touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
-            return (
-                q + 1e-3 * dq.astype(jnp.bfloat16) + kv_touch.astype(jnp.bfloat16)
-            ).astype(jnp.bfloat16)
-
+        body = make_consume_all_grads_body(
+            lambda q: g(q, k, v), jnp.bfloat16
+        )
         dtb = scan_time(body, q0, length=6, reps=2)
         return dtb, 4 * area * D * HQ * 3.5 / (dtb * 1e-3) / 1e12
 

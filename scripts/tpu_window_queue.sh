@@ -50,10 +50,13 @@ run_queue() {
   TS=$(date -u +%m%d_%H%M)
   run_step 900 ".tpu_logs/${TS}_smoke.log" python -u scripts/tpu_smoke.py || return
   run_step 1500 ".tpu_logs/${TS}_bench.log" python -u bench.py || return
-  run_step 1200 ".tpu_logs/${TS}_calibrate.log" python -u scripts/tpu_calibrate.py || return
   run_step 2400 ".tpu_logs/${TS}_probe.log" python -u scripts/tpu_perf_probe.py || return
   run_step 2400 ".tpu_logs/${TS}_grid.log" python -u benchmarks/kernel_bench.py \
     --seqlens 4096,8192,32768 --backward || return
+  # chip-static calibration (matmul ceiling, launch overhead, bundled-kernel
+  # A/B) after the kernel-dependent steps: short windows must spend their
+  # minutes on the measurements each round actually needs
+  run_step 1200 ".tpu_logs/${TS}_calibrate.log" python -u scripts/tpu_calibrate.py || return
   run_step 1200 ".tpu_logs/${TS}_profile.log" python -u scripts/tpu_profile_ffa.py .tpu_logs/ffa_trace
   # unproven-on-silicon step last so its failure can't cost the trace
   run_step 900 ".tpu_logs/${TS}_overlap.log" python -u scripts/tpu_overlap_tax.py

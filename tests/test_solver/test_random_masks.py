@@ -49,11 +49,14 @@ def random_mask(seed: int):
     return qr, kr, tm
 
 
-def reconstruct(qr, kr, tm, cp_size, degree, dispatch_config=None):
+def reconstruct(qr, kr, tm, cp_size, degree, dispatch_config=None,
+                overlap_config=None):
     q_ranges = AttnRanges.from_ranges(qr)
     k_ranges = AttnRanges.from_ranges(kr)
     types = [AttnMaskType.from_int_type(t) for t in tm]
-    config = DistAttnConfig(overlap_config=OverlapConfig(degree=degree))
+    config = DistAttnConfig(
+        overlap_config=overlap_config or OverlapConfig(degree=degree)
+    )
     meta_q, meta_kv, bucket = make_dispatch_meta_from_qk_ranges(
         q_ranges, k_ranges, types, S, S, CHUNK, cp_size,
         dispatch_config=dispatch_config,
