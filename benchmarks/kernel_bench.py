@@ -15,7 +15,6 @@ import argparse
 import json
 import os
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
@@ -105,6 +104,7 @@ def main() -> int:
     import numpy as np
 
     from magiattention_tpu.benchmarking.bench import (
+        do_bench_scan,
         make_consume_all_grads_body,
     )
     from magiattention_tpu.benchmarking.perf_report import (
@@ -118,12 +118,7 @@ def main() -> int:
     HQ, HK, D = args.heads, args.kv_heads, args.head_dim
     peak = 197.0
 
-    from magiattention_tpu.benchmarking.bench import do_bench_scan
-
     def scan_time(body, init, length=6, reps=2):
-        # do_bench_scan forces a value fetch after block_until_ready —
-        # required on the tunneled backend, where block_until_ready alone
-        # can return before remote execution completes
         return do_bench_scan(body, init, length=length, reps=reps)
 
     rows = []

@@ -86,6 +86,15 @@ def do_bench_scan(
     return best
 
 
+def do_bench_scan_verbose(body, carry0, length=8, reps=3):
+    """:func:`do_bench_scan` + a one-line wall-clock print (chip-window
+    scripts want compile time visible in their logs)."""
+    t0 = time.perf_counter()
+    ms = do_bench_scan(body, carry0, length=length, reps=reps)
+    print(f"  [total incl compile {time.perf_counter()-t0:.0f}s]", flush=True)
+    return ms
+
+
 def make_consume_all_grads_body(grad_fn, dtype):
     """Timing body ``q -> q`` that consumes ALL of (dq, dk, dv).
 

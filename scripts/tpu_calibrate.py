@@ -15,7 +15,6 @@ Appends to benchmarks/history/{chip_calibration,ab_flash}.csv.
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -31,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from magiattention_tpu.benchmarking.bench import (  # noqa: E402
-    do_bench_scan,
+    do_bench_scan_verbose as scan_time,
     make_consume_all_grads_body,
 )
 from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
@@ -40,17 +39,6 @@ from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
 )
 
 PEAK = 197.0
-
-
-def scan_time(body, init, length=8, reps=3):
-    # do_bench_scan forces a value fetch after block_until_ready — required
-    # on the tunneled backend, where block_until_ready alone can return
-    # before remote execution completes (timing would read low and inflate
-    # the ceiling this script exists to measure)
-    t0 = time.perf_counter()
-    ms = do_bench_scan(body, init, length=length, reps=reps)
-    print(f"  [total incl compile {time.perf_counter()-t0:.0f}s]", flush=True)
-    return ms
 
 
 def main():

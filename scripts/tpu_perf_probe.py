@@ -29,19 +29,9 @@ from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
 
 
 from magiattention_tpu.benchmarking.bench import (  # noqa: E402
-    do_bench_scan,
+    do_bench_scan_verbose as scan_time,
     make_consume_all_grads_body,
 )
-
-
-def scan_time(body, init, length=8, reps=3):
-    """ms per body() call, chained through the carry. do_bench_scan forces
-    a value fetch after block_until_ready — required on the tunneled
-    backend, where block_until_ready alone can return early."""
-    t0 = time.perf_counter()
-    ms = do_bench_scan(body, init, length=length, reps=reps)
-    print(f"  [total incl compile {time.perf_counter()-t0:.0f}s]", flush=True)
-    return ms
 
 
 def main():
