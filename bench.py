@@ -27,7 +27,9 @@ import sys
 import time
 
 ATTEMPTS = 3  # per VERDICT r1: bounded retry with subprocess isolation
-WORKER_TIMEOUT_S = 420  # backend init (~minutes when flaky) + first compile
+WORKER_TIMEOUT_S = 540  # backend init (~minutes when flaky) + first compiles
+# (slope timing compiles TWO scan lengths per tiling; persistent cache
+# makes later windows cheap)
 _T_PROC_START = time.perf_counter()  # sweep budget counts init time too
 
 
@@ -55,7 +57,7 @@ def run_worker() -> int:
     import jax.numpy as jnp
 
     from magiattention_tpu.benchmarking.bench import (
-        do_bench_scan,
+        do_bench_scan_slope,
         make_consume_all_grads_body,
     )
     from magiattention_tpu.kernels.ffa import ffa_attn
@@ -102,7 +104,7 @@ def run_worker() -> int:
         grad = jax.grad(loss, argnums=(0, 1, 2))
         return make_consume_all_grads_body(lambda q: grad(q, k, v), dtype)
 
-    timing_mode = "scan"
+    timing_mode = "scan_slope"
     sweep_error = None
     sweep_points = []  # every (bq, bk) measured, for the judge's record
     env_pinned = (
@@ -118,7 +120,7 @@ def run_worker() -> int:
     try:
         if backend == "cpu":
             raise _FallbackTiming("interpret mode: skip scan timing")
-        dt_ms = do_bench_scan(make_body(block_q, block_k), q, length=6, reps=2)
+        dt_ms = do_bench_scan_slope(make_body(block_q, block_k), q, reps=2)
         sweep_points.append(
             {"block_q": block_q, "block_k": block_k, "tflops": tf(dt_ms)}
         )
@@ -131,9 +133,7 @@ def run_worker() -> int:
             if time.perf_counter() - _T_PROC_START > 180:
                 break
             try:
-                alt_ms = do_bench_scan(
-                    make_body(bq2, bk2), q, length=6, reps=2
-                )
+                alt_ms = do_bench_scan_slope(make_body(bq2, bk2), q, reps=2)
                 sweep_points.append(
                     {"block_q": bq2, "block_k": bk2, "tflops": tf(alt_ms)}
                 )
@@ -174,8 +174,8 @@ def run_worker() -> int:
             a_mm = jnp.asarray(
                 np.random.default_rng(1).standard_normal((n, n)), dtype
             )
-            mm_ms = do_bench_scan(
-                lambda x: (x @ a_mm).astype(dtype), a_mm, length=6, reps=3
+            mm_ms = do_bench_scan_slope(
+                lambda x: (x @ a_mm).astype(dtype), a_mm, reps=3
             )
             chip_matmul_tf = round(2 * n**3 / (mm_ms * 1e-3) / 1e12, 2)
         except Exception:
@@ -293,7 +293,7 @@ def run_worker() -> int:
                                 block_q=env_bq, block_k=env_bk)
                 return o.astype(dtype)
 
-            v_ms = do_bench_scan(vbody, qv, length=6, reps=2)
+            v_ms = do_bench_scan_slope(vbody, qv, reps=2)
             v_area = int(bm.sum()) * block * block
             v_tflops = 4 * v_area * D * HQ / (v_ms * 1e-3) / 1e12
             result["video_tflops_fwd"] = round(v_tflops, 2)

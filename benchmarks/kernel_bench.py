@@ -104,7 +104,7 @@ def main() -> int:
     import numpy as np
 
     from magiattention_tpu.benchmarking.bench import (
-        do_bench_scan,
+        do_bench_scan_slope,
         make_consume_all_grads_body,
     )
     from magiattention_tpu.benchmarking.perf_report import (
@@ -118,8 +118,11 @@ def main() -> int:
     HQ, HK, D = args.heads, args.kv_heads, args.head_dim
     peak = 197.0
 
-    def scan_time(body, init, length=6, reps=2):
-        return do_bench_scan(body, init, length=length, reps=reps)
+    def scan_time(body, init, reps=2):
+        # slope timing (cancels the tunnel's ~170 ms fixed per-launch cost
+        # — benchmarks/history/chip_calibration.csv); falls back to a short
+        # plain scan off-TPU inside the helper
+        return do_bench_scan_slope(body, init, reps=reps, verbose=True)
 
     rows = []
     rng = np.random.default_rng(0)

@@ -26,7 +26,7 @@ if "--smoke" in sys.argv:
 import jax.numpy as jnp
 import numpy as np
 
-from magiattention_tpu.benchmarking.bench import do_bench_scan
+from magiattention_tpu.benchmarking.bench import do_bench_scan_slope
 from magiattention_tpu.benchmarking.perf_report import append_row
 from magiattention_tpu.functional.dist_attn import _multi_ffa
 from magiattention_tpu.kernels.ffa import default_blocks
@@ -82,7 +82,7 @@ def main():
             )
             return out.astype(jnp.bfloat16)
 
-        ms = do_bench_scan(body, q, length=6, reps=2)
+        ms = do_bench_scan_slope(body, q, reps=2, verbose=True)
         tf = flops / (ms * 1e-3) / 1e12
         tax = 0.0 if base_ms is None else (ms - base_ms) / base_ms * 100
         if base_ms is None:

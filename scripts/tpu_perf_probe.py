@@ -29,9 +29,16 @@ from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
 
 
 from magiattention_tpu.benchmarking.bench import (  # noqa: E402
-    do_bench_scan_verbose as scan_time,
+    do_bench_scan_slope,
     make_consume_all_grads_body,
 )
+
+
+def scan_time(body, init):
+    # slope timing: cancels the tunnel's ~170 ms fixed per-launch cost
+    # (benchmarks/history/chip_calibration.csv, 2026-07-31); verbose keeps
+    # compile wall-clock visible so a window timeout is diagnosable
+    return do_bench_scan_slope(body, init, reps=2, verbose=True)
 
 
 def main():
@@ -60,7 +67,7 @@ def main():
         dt = scan_time(
             lambda q: ffa_attn(q, k, v, qr, kr, tm, block_q=bq,
                                block_k=bk)[0].astype(jnp.bfloat16),
-            q0, length=6, reps=2,
+            q0,
         )
         return dt, 4 * area * D * HQ / (dt * 1e-3) / 1e12
 
@@ -73,7 +80,7 @@ def main():
         body = make_consume_all_grads_body(
             lambda q: g(q, k, v), jnp.bfloat16
         )
-        dtb = scan_time(body, q0, length=6, reps=2)
+        dtb = scan_time(body, q0)
         return dtb, 4 * area * D * HQ * 3.5 / (dtb * 1e-3) / 1e12
 
     for bq, bk in [(256, 512), (512, 512), (512, 1024), (1024, 512),

@@ -1,0 +1,166 @@
+"""Slope-timed (launch-overhead-free) chip ceiling + FFA kernel rates.
+
+Exists because of the 2026-07-31 calibration finding: the tunnel charges
+~170 ms of fixed cost per executable launch, so every length-6-scan
+measurement this round and last (10 TF/s headline, the "34 TF/s chip
+ceiling") was overhead-dominated, not kernel-dominated. All probes here
+use :func:`do_bench_scan_slope` (two trip counts, slope cancels the
+fixed cost) and append to ``benchmarks/history/true_rate.csv``.
+
+Measures: bf16 matmul ceiling (the honest MFU denominator), FFA fwd and
+fwd+bwd at the bench shape across tilings, and the bundled
+``flash_attention`` A/B on the identical dense-causal problem.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+try:
+    from magiattention_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+except Exception:
+    pass
+import jax.numpy as jnp
+import numpy as np
+
+from magiattention_tpu.benchmarking.bench import (  # noqa: E402
+    do_bench_scan_slope,
+    make_consume_all_grads_body,
+)
+from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
+    HW_FWD_BWD_RATIO,
+    append_row,
+)
+
+PEAK = 197.0
+LENGTHS = (24, 96)
+
+
+def record(probe, ms, flops):
+    tf = flops / (ms * 1e-3) / 1e12
+    print(f"{probe}: {ms:.3f} ms {tf:.1f} TF/s ({tf/PEAK*100:.1f}% of nominal)",
+          flush=True)
+    append_row("true_rate", {
+        "probe": probe, "ms": round(ms, 4), "tflops": round(tf, 2),
+        "pct_of_nominal": round(tf / PEAK * 100, 1),
+        "len_short": LENGTHS[0], "len_long": LENGTHS[1],
+    })
+    return tf
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices(), flush=True)
+    rng = np.random.default_rng(0)
+
+    # -- 1. matmul ceiling (slope) ---------------------------------------
+    ceiling = 0.0
+    for n in (4096, 8192):
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.bfloat16)
+        try:
+            ms = do_bench_scan_slope(
+                lambda x, a=a: (x @ a).astype(jnp.bfloat16), a, verbose=True
+            )
+            ceiling = max(ceiling, record(f"mm{n}", ms, 2 * n**3))
+        except Exception as e:
+            print(f"mm{n}: FAIL {type(e).__name__}: {str(e)[:160]}", flush=True)
+    if ceiling:
+        append_row("true_rate", {
+            "probe": "ceiling", "ms": 0.0, "tflops": round(ceiling, 2),
+            "pct_of_nominal": round(ceiling / PEAK * 100, 1),
+            "len_short": LENGTHS[0], "len_long": LENGTHS[1],
+        })
+
+    # -- 2. FFA on the bench shape (slope), tiling mini-sweep ------------
+    from magiattention_tpu.kernels.ffa import ffa_attn
+
+    S, HQ, HK, D = 4096, 16, 8, 128
+    area = S * (S + 1) // 2
+    fwd_flops = 4 * area * D * HQ
+    qs = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.bfloat16)
+    ks = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.bfloat16)
+    vs = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.bfloat16)
+    ws = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.bfloat16)
+    qr = np.array([[0, S]], np.int32)
+    kr = np.array([[0, S]], np.int32)
+    tm = np.array([1], np.int32)
+
+    for bq, bk in [(256, 512), (512, 512), (512, 1024), (1024, 1024)]:
+        def ffa_fwd(q, bq=bq, bk=bk):
+            return ffa_attn(
+                q, ks, vs, qr, kr, tm, block_q=bq, block_k=bk
+            )[0].astype(jnp.bfloat16)
+
+        def ffa_loss(q, k, v, bq=bq, bk=bk):
+            o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) * ws.astype(jnp.float32))
+
+        try:
+            ms = do_bench_scan_slope(ffa_fwd, qs, verbose=True)
+            record(f"ffa_fwd_bq{bq}_bk{bk}", ms, fwd_flops)
+            g = jax.grad(ffa_loss, argnums=(0, 1, 2))
+            step = make_consume_all_grads_body(
+                lambda q, g=g: g(q, ks, vs), jnp.bfloat16
+            )
+            msb = do_bench_scan_slope(step, qs, verbose=True)
+            record(f"ffa_fwdbwd_bq{bq}_bk{bk}", msb, fwd_flops * 3.5)
+            record(f"ffa_fwdbwd_hw_bq{bq}_bk{bk}", msb,
+                   fwd_flops * 3.5 * HW_FWD_BWD_RATIO)
+        except Exception as e:
+            print(f"ffa bq{bq} bk{bk}: FAIL {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+    # -- 3. bundled flash_attention A/B (slope, equal heads) -------------
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+    except Exception as e:
+        print(f"bundled flash unavailable: {e}", flush=True)
+        return
+    H = HQ
+    ab_flops = 4 * area * D * H
+    qb = jnp.asarray(rng.standard_normal((1, H, S, D)), jnp.bfloat16)
+    kb = jnp.asarray(rng.standard_normal((1, H, S, D)), jnp.bfloat16)
+    vb = jnp.asarray(rng.standard_normal((1, H, S, D)), jnp.bfloat16)
+    wb = jnp.asarray(rng.standard_normal((1, H, S, D)), jnp.bfloat16)
+
+    def bundled_fwd(q):
+        return flash_attention(q, kb, vb, causal=True).astype(jnp.bfloat16)
+
+    def bundled_loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32) * wb.astype(jnp.float32))
+
+    try:
+        ms = do_bench_scan_slope(bundled_fwd, qb, verbose=True)
+        record("bundled_fwd", ms, ab_flops)
+        g = jax.grad(bundled_loss, argnums=(0, 1, 2))
+        step = make_consume_all_grads_body(lambda q: g(q, kb, vb), jnp.bfloat16)
+        msb = do_bench_scan_slope(step, qb, verbose=True)
+        record("bundled_fwdbwd", msb, ab_flops * 3.5)
+    except Exception as e:
+        print(f"bundled: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
+
+    # equal-heads FFA for a like-for-like vs bundled (GQA off)
+    ksf = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    vsf = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+
+    def ffa_fwd_eq(q):
+        return ffa_attn(
+            q, ksf, vsf, qr, kr, tm, block_q=512, block_k=512
+        )[0].astype(jnp.bfloat16)
+
+    try:
+        ms = do_bench_scan_slope(ffa_fwd_eq, qs, verbose=True)
+        record("ffa_fwd_eqheads_bq512_bk512", ms, ab_flops)
+    except Exception as e:
+        print(f"ffa eqheads: FAIL {type(e).__name__}: {str(e)[:200]}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,11 @@ run_step() {  # run_step <timeout> <logfile> <cmd...>
 run_queue() {
   TS=$(date -u +%m%d_%H%M)
   run_step 900 ".tpu_logs/${TS}_smoke.log" python -u scripts/tpu_smoke.py || return
+  # slope-timed (launch-overhead-free) ceiling + kernel rates FIRST: the
+  # 2026-07-31 calibration showed every length-6-scan number is dominated
+  # by the tunnel's ~170 ms fixed per-launch cost — these are the numbers
+  # the round actually needs
+  run_step 1800 ".tpu_logs/${TS}_true_rate.log" python -u scripts/tpu_true_rate.py || return
   run_step 1500 ".tpu_logs/${TS}_bench.log" python -u bench.py || return
   run_step 2400 ".tpu_logs/${TS}_probe.log" python -u scripts/tpu_perf_probe.py || return
   run_step 2400 ".tpu_logs/${TS}_grid.log" python -u benchmarks/kernel_bench.py \
