@@ -124,7 +124,7 @@ def run_worker() -> int:
         sweep_points.append(
             {"block_q": block_q, "block_k": block_k, "tflops": tf(dt_ms)}
         )
-        # mini-sweep: try alternative tilings while the worker's 420s
+        # mini-sweep: try alternative tilings while the worker's 540s
         # hard-cap (which started at process birth — backend init included)
         # still has slack. Skipped when the operator pinned the blocks.
         for bq2, bk2 in ((256, 512), (512, 1024)):

@@ -118,22 +118,63 @@ def do_bench_scan_slope(
     short, long_ = lengths
     assert long_ > short
     t0 = time.perf_counter()
-    t_short = do_bench_scan(body, carry0, length=short, reps=reps)
-    t_long = do_bench_scan(body, carry0, length=long_, reps=reps)
-    slope = (t_long * long_ - t_short * short) / (long_ - short)
+
+    def make_runner(length):
+        @jax.jit
+        def run(c):
+            def f(c, _):
+                return body(c), None
+
+            c, _ = jax.lax.scan(f, c, None, length=length)
+            return c
+
+        out = run(carry0)  # compile + warm
+        jax.block_until_ready(out)
+
+        def time_once() -> float:  # total seconds for one launch
+            import jax.numpy as jnp
+
+            t = time.perf_counter()
+            o = run(carry0)
+            jax.block_until_ready(o)
+            # force a real value fetch (block_until_ready alone can return
+            # before remote execution on tunneled backends)
+            jnp.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[0].item()
+            return time.perf_counter() - t
+
+        return time_once
+
+    run_short = make_runner(short)
+    run_long = make_runner(long_)
+    # PAIRED reps: each rep times short and long back-to-back so both see
+    # the same tunnel conditions, then contributes its own slope; the
+    # median rejects a rep whose overhead drifted mid-pair. (Independent
+    # best-of-reps runs would subtract overhead samples from different
+    # moments — a 50 ms drift over the 72-step delta fakes ~0.7 ms/step.)
+    slopes = []
+    t_long_best = float("inf")
+    for _ in range(max(reps, 2)):
+        ts = run_short() * 1e3
+        tl = run_long() * 1e3
+        t_long_best = min(t_long_best, tl / long_)
+        slopes.append((tl - ts) / (long_ - short))
+    slope = float(np.median(slopes))
+    ok = 0.0 < slope <= t_long_best
     if verbose:
         print(
             f"  [slope timing incl compile {time.perf_counter()-t0:.0f}s: "
-            f"len{short} {t_short:.3f} / len{long_} {t_long:.3f} ms/step "
-            f"-> slope {slope:.3f}]",
+            f"per-rep slopes {[round(s, 3) for s in slopes]} ms/step"
+            + ("" if ok else
+               f" -> NOISE GUARD: fallback to len{long_} upper bound "
+               f"{t_long_best:.3f}"),
             flush=True,
         )
-    # noise guard: the two runs hit different tunnel conditions when the
-    # slope is non-positive (per-step time GREW with trip count) or exceeds
-    # the long-scan per-step time (negative implied overhead). Fall back to
-    # the long-scan number — a true upper bound on the kernel time.
-    if not 0.0 < slope <= t_long:
-        return t_long
+    # noise guard: non-positive slope (long ran FASTER than short) or slope
+    # above the long-scan per-step time (negative implied overhead) means
+    # the pair medians are still contaminated; the long-scan per-step time
+    # is a true upper bound on the kernel time.
+    if not ok:
+        return t_long_best
     return slope
 
 

@@ -5,7 +5,10 @@ Pipeline (ref: SURVEY §3.1):
   make_attn_meta_from_dispatch_meta  -> CommMeta + CalcMeta (per-rank plans)
 """
 
-from ._make_dispatch_meta import make_dispatch_meta_from_qk_ranges  # noqa: F401
+from ._make_dispatch_meta import (  # noqa: F401
+    make_dispatch_meta_from_qk_ranges,
+    make_global_bucket_from_qk_ranges,
+)
 from ._make_attn_meta import make_attn_meta_from_dispatch_meta  # noqa: F401
 from .collection.dispatch_meta import DispatchMeta  # noqa: F401
 from .collection.calc_meta import AttnArg, CalcMeta  # noqa: F401

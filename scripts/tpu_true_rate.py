@@ -62,7 +62,8 @@ def main():
         a = jnp.asarray(rng.standard_normal((n, n)), jnp.bfloat16)
         try:
             ms = do_bench_scan_slope(
-                lambda x, a=a: (x @ a).astype(jnp.bfloat16), a, verbose=True
+                lambda x, a=a: (x @ a).astype(jnp.bfloat16), a,
+                lengths=LENGTHS, verbose=True,
             )
             ceiling = max(ceiling, record(f"mm{n}", ms, 2 * n**3))
         except Exception as e:
@@ -99,13 +100,13 @@ def main():
             return jnp.sum(o.astype(jnp.float32) * ws.astype(jnp.float32))
 
         try:
-            ms = do_bench_scan_slope(ffa_fwd, qs, verbose=True)
+            ms = do_bench_scan_slope(ffa_fwd, qs, lengths=LENGTHS, verbose=True)
             record(f"ffa_fwd_bq{bq}_bk{bk}", ms, fwd_flops)
             g = jax.grad(ffa_loss, argnums=(0, 1, 2))
             step = make_consume_all_grads_body(
                 lambda q, g=g: g(q, ks, vs), jnp.bfloat16
             )
-            msb = do_bench_scan_slope(step, qs, verbose=True)
+            msb = do_bench_scan_slope(step, qs, lengths=LENGTHS, verbose=True)
             record(f"ffa_fwdbwd_bq{bq}_bk{bk}", msb, fwd_flops * 3.5)
             record(f"ffa_fwdbwd_hw_bq{bq}_bk{bk}", msb,
                    fwd_flops * 3.5 * HW_FWD_BWD_RATIO)
@@ -113,7 +114,26 @@ def main():
             print(f"ffa bq{bq} bk{bk}: FAIL {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
-    # -- 3. bundled flash_attention A/B (slope, equal heads) -------------
+    # -- 3. A/B vs bundled flash_attention (slope, equal heads) ----------
+    H = HQ
+    ab_flops = 4 * area * D * H
+    # equal-heads FFA for a like-for-like vs bundled (GQA off)
+    ksf = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    vsf = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+
+    def ffa_fwd_eq(q):
+        return ffa_attn(
+            q, ksf, vsf, qr, kr, tm, block_q=512, block_k=512
+        )[0].astype(jnp.bfloat16)
+
+    try:
+        ms = do_bench_scan_slope(ffa_fwd_eq, qs, lengths=LENGTHS, verbose=True)
+        record("ffa_fwd_eqheads_bq512_bk512", ms, ab_flops)
+    except Exception as e:
+        print(f"ffa eqheads: FAIL {type(e).__name__}: {str(e)[:200]}",
+              flush=True)
+
+    # bundled kernel (guarded: its absence must not cost the probes above)
     try:
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention,
@@ -121,8 +141,6 @@ def main():
     except Exception as e:
         print(f"bundled flash unavailable: {e}", flush=True)
         return
-    H = HQ
-    ab_flops = 4 * area * D * H
     qb = jnp.asarray(rng.standard_normal((1, H, S, D)), jnp.bfloat16)
     kb = jnp.asarray(rng.standard_normal((1, H, S, D)), jnp.bfloat16)
     vb = jnp.asarray(rng.standard_normal((1, H, S, D)), jnp.bfloat16)
@@ -136,30 +154,15 @@ def main():
         return jnp.sum(o.astype(jnp.float32) * wb.astype(jnp.float32))
 
     try:
-        ms = do_bench_scan_slope(bundled_fwd, qb, verbose=True)
+        ms = do_bench_scan_slope(bundled_fwd, qb, lengths=LENGTHS, verbose=True)
         record("bundled_fwd", ms, ab_flops)
         g = jax.grad(bundled_loss, argnums=(0, 1, 2))
         step = make_consume_all_grads_body(lambda q: g(q, kb, vb), jnp.bfloat16)
-        msb = do_bench_scan_slope(step, qb, verbose=True)
+        msb = do_bench_scan_slope(step, qb, lengths=LENGTHS, verbose=True)
         record("bundled_fwdbwd", msb, ab_flops * 3.5)
     except Exception as e:
         print(f"bundled: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
 
-    # equal-heads FFA for a like-for-like vs bundled (GQA off)
-    ksf = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
-    vsf = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
-
-    def ffa_fwd_eq(q):
-        return ffa_attn(
-            q, ksf, vsf, qr, kr, tm, block_q=512, block_k=512
-        )[0].astype(jnp.bfloat16)
-
-    try:
-        ms = do_bench_scan_slope(ffa_fwd_eq, qs, verbose=True)
-        record("ffa_fwd_eqheads_bq512_bk512", ms, ab_flops)
-    except Exception as e:
-        print(f"ffa eqheads: FAIL {type(e).__name__}: {str(e)[:200]}",
-              flush=True)
 
 
 if __name__ == "__main__":

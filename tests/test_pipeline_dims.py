@@ -1,0 +1,192 @@
+"""Dimensional pipeline oracle: world_size x mask x heads x head_dim x
+dtype x backend.
+
+The always-on pipeline suite (test_pipeline.py) pins S=256, hq=2, hk=1,
+d=32, fp32; the reference's oracle sweeps the dimensional axes too
+(ref tests/test_pipeline.py: world_size x mask x (nh, hd) x dtype x
+backend with rank-synchronized sampling). This file covers those axes
+with a curated config set sized for the CPU-interpret budget: every
+config runs the REAL pipeline (plan key -> dispatch -> calc_attn ->
+undispatch, + backward on a subset) against the dense fp32 oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.api import (
+    calc_attn,
+    clear_cache,
+    dispatch,
+    magi_attn_flex_key,
+    undispatch,
+)
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.testing import assert_close, ref_attn
+from magiattention_tpu.testing.flag_generator import with_flags
+
+FULL, CAUSAL, INV, BI = 0, 1, 2, 3
+
+
+def make_case(name, s):
+    """Mask families scaled to total seqlen ``s`` (mirrors test_pipeline)."""
+    if name == "causal":
+        return [[0, s]], [[0, s]], [CAUSAL]
+    if name == "varlen_causal":
+        b = [0, (3 * s) // 8, (5 * s) // 8, s]
+        qr = [[a, c] for a, c in zip(b[:-1], b[1:])]
+        return qr, qr, [CAUSAL] * 3
+    if name == "sliding_window":
+        w = s // 4
+        return [[0, w], [w, s]], [[0, w], [0, s]], [CAUSAL, BI]
+    if name == "inv_causal_mix":
+        h = s // 2
+        return [[0, h], [h, s]], [[0, h], [h, s]], [INV, CAUSAL]
+    raise ValueError(name)
+
+
+# (case, cp, hq, hk, d, dtype, backend, backward): each row widens at
+# least one axis the always-on oracle pins.
+CONFIGS = [
+    # GQA ratios inside the CP pipeline
+    ("causal", 4, 4, 2, 64, "f32", "ffa", True),
+    ("varlen_causal", 8, 8, 2, 64, "f32", "sdpa_online", False),
+    # bf16 end-to-end (dispatch comms + kernel + undispatch in bf16)
+    ("sliding_window", 4, 2, 1, 128, "bf16", "ffa", True),
+    ("inv_causal_mix", 4, 4, 4, 64, "bf16", "sdpa", False),
+    # world sizes the oracle doesn't touch
+    ("inv_causal_mix", 2, 2, 1, 64, "f32", "ffa", True),
+    ("causal", 8, 2, 2, 128, "f32", "ffa", False),
+]
+
+S = 256
+CHUNK = 16
+
+
+def _dtype(tag):
+    return jnp.float32 if tag == "f32" else jnp.bfloat16
+
+
+@pytest.mark.parametrize(
+    "case,cp,hq,hk,d,dtype_tag,backend,backward",
+    CONFIGS,
+    ids=[f"{c[0]}-cp{c[1]}-h{c[2]}.{c[3]}-d{c[4]}-{c[5]}-{c[6]}"
+         for c in CONFIGS],
+)
+def test_pipeline_dims(case, cp, hq, hk, d, dtype_tag, backend, backward):
+    qr, kr, tm = make_case(case, S)
+    dtype = _dtype(dtype_tag)
+    devs = np.array(jax.devices("cpu")[:cp])
+    mesh = jax.sharding.Mesh(devs, axis_names=("cp",))
+
+    rng = np.random.default_rng(hash((case, cp, hq, d)) % 2**31)
+    q = jnp.asarray(rng.standard_normal((S, hq, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((S, hk, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((S, hk, d)), dtype)
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        [AttnMaskType.from_int_type(t) for t in tm],
+        total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+
+    with with_flags({"MAGI_ATTENTION_KERNEL_BACKEND": backend}):
+        clear_cache()
+        key = magi_attn_flex_key(
+            qr, kr, tm, S, S, mesh=mesh, cp_axis="cp", chunk_size=CHUNK
+        )
+
+        def fwd(q, k, v):
+            out_d, meta = calc_attn(
+                dispatch(q, key), dispatch(k, key, role="kv"),
+                dispatch(v, key, role="kv"), key,
+            )
+            return undispatch(out_d, key), undispatch(meta.lse, key)
+
+        out, lse = jax.jit(fwd)(q, k, v)
+        out_ref, lse_ref = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+        # fp32: planner/comm must be exact to oracle precision; bf16: one
+        # rounding per cast boundary (same bounds as test_ffa_grid)
+        tol, ntol = (1e-4, 3e-5) if dtype_tag == "f32" else (2e-2, 5e-3)
+        assert_close(
+            out.astype(jnp.float32), out_ref.astype(jnp.float32),
+            atol=tol, rtol=tol, norm_rtol=ntol,
+            msg=f"{case} cp{cp} h{hq}/{hk} d{d} {dtype_tag} {backend} out",
+        )
+        # lse is fp32 regardless of io dtype; bf16 inputs shift each logit
+        # by input rounding (~1e-2 elementwise), so only the norm bound is
+        # tight there
+        lse_tol, lse_ntol = (
+            (1e-3, 3e-5) if dtype_tag == "f32" else (5e-2, 2e-3)
+        )
+        assert_close(
+            lse, lse_ref, atol=lse_tol, rtol=lse_tol, norm_rtol=lse_ntol,
+            msg=f"{case} cp{cp} lse",
+        )
+
+        if backward:
+            w = jnp.asarray(
+                rng.standard_normal((S, hq, d)), jnp.float32
+            )
+
+            def loss_cp(q, k, v):
+                o, _ = fwd(q, k, v)
+                return jnp.sum(o.astype(jnp.float32) * w)
+
+            def loss_ref(q, k, v):
+                o, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+                return jnp.sum(o.astype(jnp.float32) * w)
+
+            g = jax.jit(jax.grad(loss_cp, argnums=(0, 1, 2)))(q, k, v)
+            g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            gtol, gntol = (
+                (1e-3, 3e-4) if dtype_tag == "f32" else (5e-2, 1e-2)
+            )
+            for name, a, b in zip("dq dk dv".split(), g, g_ref):
+                assert_close(
+                    a.astype(jnp.float32), b.astype(jnp.float32),
+                    atol=gtol, rtol=gtol, norm_rtol=gntol,
+                    msg=f"{case} cp{cp} h{hq}/{hk} d{d} {dtype_tag} {name}",
+                )
+    clear_cache()
+
+
+def test_pipeline_uneven_total():
+    """Total seqlen NOT divisible by cp * chunk: uneven shards end-to-end
+    (ref dispatch uneven coverage tests/test_dispatch/test_uneven_shard.py,
+    here driven through the full pipeline)."""
+    s = 272  # 17 chunks of 16 over cp=4 -> ranks get 5/4/4/4
+    qr, kr, tm = make_case("causal", s)
+    devs = np.array(jax.devices("cpu")[:4])
+    mesh = jax.sharding.Mesh(devs, axis_names=("cp",))
+    from magiattention_tpu.config import DispatchConfig, DistAttnConfig
+
+    key = magi_attn_flex_key(
+        qr, kr, tm, s, s, mesh=mesh, cp_axis="cp", chunk_size=CHUNK,
+        dist_attn_config=DistAttnConfig(
+            dispatch_config=DispatchConfig(uneven_shard=True)
+        ),
+    )
+    rng = np.random.default_rng(29)
+    q = jnp.asarray(rng.standard_normal((s, 2, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((s, 1, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((s, 1, 64)), jnp.float32)
+
+    def fwd(q, k, v):
+        out_d, _ = calc_attn(
+            dispatch(q, key), dispatch(k, key, role="kv"),
+            dispatch(v, key, role="kv"), key,
+        )
+        return undispatch(out_d, key)
+
+    out = jax.jit(fwd)(q, k, v)
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        [AttnMaskType.from_int_type(t) for t in tm],
+        total_seqlen_q=s, total_seqlen_k=s,
+    ).mask_array
+    out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
+                 msg="uneven total")
