@@ -39,6 +39,9 @@ def __getattr__(name):
         "undispatch",
         "calc_attn",
         "get_position_ids",
+        # reference top-level names (ref __init__.py:86-97)
+        "init_dist_attn_runtime_key",
+        "init_dist_attn_runtime_mgr",
     ):
         from . import api
 

@@ -1,10 +1,12 @@
 """User-facing API (ref: magi_attention/api/)."""
 
 from .functools import (  # noqa: F401
+    apply_padding,
     compute_pad_size,
     full_attention_mask,
     infer_attn_mask_from_cu_seqlens,
     infer_attn_mask_from_sliding_window,
+    infer_varlen_mask_from_batch,
     pad_at_dim,
     squash_batch_dim,
     unpad_at_dim,
@@ -15,6 +17,8 @@ from .magi_attn_interface import (  # noqa: F401
     dispatch,
     get_most_recent_key,
     get_position_ids,
+    init_dist_attn_runtime_key,
+    init_dist_attn_runtime_mgr,
     magi_attn_flex_key,
     magi_attn_varlen_key,
     make_flex_key_for_new_mask_after_dispatch,

@@ -38,6 +38,41 @@ def infer_attn_mask_from_cu_seqlens(
     return q_ranges, k_ranges, [t] * len(q_ranges)
 
 
+def infer_varlen_mask_from_batch(
+    batch_size: int, seq_len: int
+) -> tuple[list[int], list[int]]:
+    """Fixed-length batch -> varlen cu_seqlens (ref functools.py:68): the
+    packed-layout cumulative boundaries [0, s, 2s, ..., b*s] for q and k.
+    Host lists, not device arrays — they feed the (host-side) planners."""
+    cu = [i * seq_len for i in range(batch_size + 1)]
+    return cu, list(cu)
+
+
+def apply_padding(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    attn_mask_type: list[AttnMaskType],
+    total_seqlen: int,
+    pad_size: int,
+) -> tuple[AttnRanges, AttnRanges, list[AttnMaskType]]:
+    """Append a padding q range attending an empty k range (ref :142).
+
+    The pad rows [total_seqlen, total_seqlen + pad_size) get a dummy
+    zero-length k range + FULL type: they produce out=0 / lse=-inf and are
+    sliced off by unpad_at_dim after undispatch."""
+    if pad_size <= 0:
+        return q_ranges, k_ranges, list(attn_mask_type)
+    qr = q_ranges.to_naive_ranges() + [
+        (total_seqlen, total_seqlen + pad_size)
+    ]
+    kr = k_ranges.to_naive_ranges() + [(0, 0)]
+    return (
+        AttnRanges.from_ranges(qr),
+        AttnRanges.from_ranges(kr),
+        list(attn_mask_type) + [AttnMaskType.FULL],
+    )
+
+
 def infer_attn_mask_from_sliding_window(
     q_ranges: AttnRanges,
     k_ranges: AttnRanges,

@@ -330,5 +330,73 @@ def get_most_recent_key() -> DistAttnRuntimeKey | None:
     return _most_recent_key
 
 
+def init_dist_attn_runtime_key(
+    q_ranges: AttnRanges | Sequence[Sequence[int]],
+    k_ranges: AttnRanges | Sequence[Sequence[int]],
+    attn_mask_type: Sequence[AttnMaskType | str | int],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    chunk_size: int,
+    *,
+    mesh: Mesh,
+    cp_axis: str = "cp",
+    head_axis: str | None = None,
+    pad_size: int = 0,
+    dist_attn_config: DistAttnConfig | None = None,
+) -> DistAttnRuntimeKey:
+    """Reference-named runtime-key init (ref dist_attn_runtime_mgr.py:486).
+
+    Thin adapter over :func:`magi_attn_flex_key` for migration parity:
+    ``pad_size > 0`` applies :func:`~..api.functools.apply_padding` to the
+    mask first (the reference keys on pad_size; here padding is part of the
+    mask itself). The reference's ``num_heads_q/num_heads_kv/head_dim``
+    parameters do not exist here: JAX traces tensor shapes per call, so
+    head geometry never needs to be declared at planning time.
+    """
+    if not isinstance(q_ranges, AttnRanges):
+        q_ranges = AttnRanges.from_ranges(q_ranges)
+    if not isinstance(k_ranges, AttnRanges):
+        k_ranges = AttnRanges.from_ranges(k_ranges)
+    mask_types = [AttnMaskType.normalize(t) for t in attn_mask_type]
+    if pad_size > 0:
+        from .functools import apply_padding
+
+        q_ranges, k_ranges, mask_types = apply_padding(
+            q_ranges, k_ranges, mask_types, total_seqlen_q, pad_size
+        )
+        total_seqlen_q += pad_size
+        total_seqlen_k += pad_size
+    return magi_attn_flex_key(
+        q_ranges, k_ranges, mask_types, total_seqlen_q, total_seqlen_k,
+        mesh=mesh, cp_axis=cp_axis, head_axis=head_axis,
+        chunk_size=chunk_size, dist_attn_config=dist_attn_config,
+    )
+
+
+def init_dist_attn_runtime_mgr(
+    q_ranges: AttnRanges | Sequence[Sequence[int]],
+    k_ranges: AttnRanges | Sequence[Sequence[int]],
+    attn_mask_type: Sequence[AttnMaskType | str | int],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    chunk_size: int,
+    *,
+    mesh: Mesh,
+    cp_axis: str = "cp",
+    head_axis: str | None = None,
+    pad_size: int = 0,
+    dist_attn_config: DistAttnConfig | None = None,
+) -> "DistAttnRuntimeMgr":
+    """Reference-named manager init (ref dist_attn_runtime_mgr.py:558):
+    plans the mask and returns the manager itself (sharing the same LRU as
+    the key-based API) for callers that want direct access to the metas."""
+    key = init_dist_attn_runtime_key(
+        q_ranges, k_ranges, attn_mask_type, total_seqlen_q, total_seqlen_k,
+        chunk_size, mesh=mesh, cp_axis=cp_axis, head_axis=head_axis,
+        pad_size=pad_size, dist_attn_config=dist_attn_config,
+    )
+    return _mgr(key)
+
+
 def clear_cache() -> None:
     _runtime_dict.clear()

@@ -52,6 +52,39 @@ def do_bench_mem(
     return ms, bytes_moved / (ms * 1e-3) / 1e9
 
 
+def _make_scan_runner(
+    body: Callable[[Any], Any], carry0: Any, length: int
+) -> Callable[[], float]:
+    """Compile + warm a ``length``-step chained scan of ``body``; returns a
+    closure that executes it once and returns total wall SECONDS. The one
+    place the tunnel-proof timing mechanics live: carried data dependence
+    defeats memoization, and the trailing value fetch defeats
+    block_until_ready returning before remote execution completes."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(c):
+        def f(c, _):
+            return body(c), None
+
+        c, _ = jax.lax.scan(f, c, None, length=length)
+        return c
+
+    out = run(carry0)  # compile + warm
+    jax.block_until_ready(out)
+
+    def time_once() -> float:
+        t0 = time.perf_counter()
+        o = run(carry0)
+        jax.block_until_ready(o)
+        # force a real value fetch (block_until_ready alone can return
+        # before remote execution on tunneled backends)
+        jnp.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[0].item()
+        return time.perf_counter() - t0
+
+    return time_once
+
+
 def do_bench_scan(
     body: Callable[[Any], Any],
     carry0: Any,
@@ -60,30 +93,10 @@ def do_bench_scan(
 ) -> float:
     """Per-iteration ms of ``body`` chained ``length`` times inside ONE jit
     via ``lax.scan`` — the robust timing mode on remote-tunneled devices:
-    per-dispatch RPC overhead amortizes over the scan, and the carried data
-    dependence defeats any memoization layer. ``body`` must map carry ->
-    carry of identical shape/dtype."""
-    import jax.numpy as jnp
-
-    @jax.jit
-    def run(c):
-        def f(c, _):
-            return body(c), None
-        c, _ = jax.lax.scan(f, c, None, length=length)
-        return c
-
-    out = run(carry0)
-    jax.block_until_ready(out)
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = run(carry0)
-        jax.block_until_ready(out)
-        # force a real value fetch (block_until_ready alone can return
-        # before remote execution on tunneled backends)
-        jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0].item()
-        best = min(best, (time.perf_counter() - t0) / length * 1e3)
-    return best
+    per-dispatch RPC overhead amortizes over the scan. ``body`` must map
+    carry -> carry of identical shape/dtype."""
+    time_once = _make_scan_runner(body, carry0, length)
+    return min(time_once() for _ in range(reps)) / length * 1e3
 
 
 def do_bench_scan_slope(
@@ -119,33 +132,8 @@ def do_bench_scan_slope(
     assert long_ > short
     t0 = time.perf_counter()
 
-    def make_runner(length):
-        @jax.jit
-        def run(c):
-            def f(c, _):
-                return body(c), None
-
-            c, _ = jax.lax.scan(f, c, None, length=length)
-            return c
-
-        out = run(carry0)  # compile + warm
-        jax.block_until_ready(out)
-
-        def time_once() -> float:  # total seconds for one launch
-            import jax.numpy as jnp
-
-            t = time.perf_counter()
-            o = run(carry0)
-            jax.block_until_ready(o)
-            # force a real value fetch (block_until_ready alone can return
-            # before remote execution on tunneled backends)
-            jnp.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[0].item()
-            return time.perf_counter() - t
-
-        return time_once
-
-    run_short = make_runner(short)
-    run_long = make_runner(long_)
+    run_short = _make_scan_runner(body, carry0, short)
+    run_long = _make_scan_runner(body, carry0, long_)
     # PAIRED reps: each rep times short and long back-to-back so both see
     # the same tunnel conditions, then contributes its own slope; the
     # median rejects a rep whose overhead drifted mid-pair. (Independent

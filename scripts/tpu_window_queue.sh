@@ -58,6 +58,9 @@ run_queue() {
   run_step 2400 ".tpu_logs/${TS}_probe.log" python -u scripts/tpu_perf_probe.py || return
   run_step 2400 ".tpu_logs/${TS}_grid.log" python -u benchmarks/kernel_bench.py \
     --seqlens 4096,8192,32768 --backward || return
+  # BASELINE config 4: the Magi-1 video block mask at its full 131k seqlen
+  run_step 1800 ".tpu_logs/${TS}_video131k.log" python -u benchmarks/kernel_bench.py \
+    --seqlens 131072 --masks video --backward || return
   # chip-static calibration (matmul ceiling, launch overhead, bundled-kernel
   # A/B) after the kernel-dependent steps: short windows must spend their
   # minutes on the measurements each round actually needs

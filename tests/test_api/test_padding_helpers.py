@@ -1,0 +1,89 @@
+"""Padding-helper parity (ref api/functools.py:27-178): apply_padding +
+compute_pad_size drive an unaligned total seqlen through the REAL pipeline
+and the pad rows come back out inert."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from magiattention_tpu.api import (
+    apply_padding,
+    calc_attn,
+    compute_pad_size,
+    dispatch,
+    infer_varlen_mask_from_batch,
+    magi_attn_flex_key,
+    pad_at_dim,
+    undispatch,
+    unpad_at_dim,
+)
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.testing import assert_close, ref_attn
+
+CHUNK = 16
+
+
+def test_infer_varlen_mask_from_batch():
+    cu_q, cu_k = infer_varlen_mask_from_batch(3, 128)
+    assert cu_q == [0, 128, 256, 384]
+    assert cu_k == cu_q and cu_k is not cu_q  # independent lists
+
+
+def test_apply_padding_noop_when_zero():
+    qr = AttnRanges.from_ranges([[0, 64]])
+    kr = AttnRanges.from_ranges([[0, 64]])
+    q2, k2, t2 = apply_padding(qr, kr, [AttnMaskType.CAUSAL], 64, 0)
+    assert q2 is qr and k2 is kr and t2 == [AttnMaskType.CAUSAL]
+
+
+def test_padded_pipeline_matches_unpadded_reference():
+    """S=200 (not divisible by cp*chunk=64): pad to 256, run the pipeline,
+    unpad; result must equal the dense reference on the original 200 rows,
+    and the pad rows must be exactly zero before unpadding."""
+    S = 200
+    cp = 4
+    pad = compute_pad_size(S, cp, CHUNK)
+    assert pad == 56
+    qr = AttnRanges.from_ranges([[0, S]])
+    kr = AttnRanges.from_ranges([[0, S]])
+    types = [AttnMaskType.CAUSAL]
+    qr_p, kr_p, types_p = apply_padding(qr, kr, types, S, pad)
+    assert qr_p.to_naive_ranges()[-1] == (S, S + pad)
+    assert kr_p.to_naive_ranges()[-1] == (0, 0)
+
+    devs = np.array(jax.devices("cpu")[:cp])
+    mesh = jax.sharding.Mesh(devs, axis_names=("cp",))
+    key = magi_attn_flex_key(
+        [list(r) for r in qr_p.to_naive_ranges()],
+        [list(r) for r in kr_p.to_naive_ranges()],
+        [t.to_int_type() for t in types_p],
+        S + pad, S + pad, mesh=mesh, cp_axis="cp", chunk_size=CHUNK,
+    )
+
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((S, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    qp = pad_at_dim(q, 0, pad)
+    kp = pad_at_dim(k, 0, pad)
+    vp = pad_at_dim(v, 0, pad)
+
+    def fwd(q, k, v):
+        out_d, _ = calc_attn(
+            dispatch(q, key), dispatch(k, key, role="kv"),
+            dispatch(v, key, role="kv"), key,
+        )
+        return undispatch(out_d, key)
+
+    out_p = jax.jit(fwd)(qp, kp, vp)
+    np.testing.assert_array_equal(np.asarray(out_p[S:]), 0.0)
+    out = unpad_at_dim(out_p, 0, S)
+
+    mask = AttnMask.from_ranges(
+        qr, kr, types, total_seqlen_q=S, total_seqlen_k=S
+    ).mask_array
+    out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
+                 msg="padded pipeline out")

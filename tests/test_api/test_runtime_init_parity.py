@@ -1,0 +1,124 @@
+"""Reference-named runtime init entry points (ref dist_attn_runtime_mgr.py
+:486 init_dist_attn_runtime_key, :558 init_dist_attn_runtime_mgr, exported
+at package top level per ref __init__.py:86-97)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import magiattention_tpu
+from magiattention_tpu.api import (
+    calc_attn,
+    compute_pad_size,
+    dispatch,
+    init_dist_attn_runtime_key,
+    init_dist_attn_runtime_mgr,
+    magi_attn_flex_key,
+    pad_at_dim,
+    undispatch,
+    unpad_at_dim,
+)
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.testing import assert_close, ref_attn
+
+S, CHUNK = 256, 16
+
+
+def _mesh(cp=4):
+    return jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:cp]), axis_names=("cp",)
+    )
+
+
+def test_top_level_exports():
+    assert magiattention_tpu.init_dist_attn_runtime_key is (
+        init_dist_attn_runtime_key
+    )
+    assert magiattention_tpu.init_dist_attn_runtime_mgr is (
+        init_dist_attn_runtime_mgr
+    )
+
+
+def test_key_matches_flex_key():
+    """Same mask through both entry points -> the SAME cache key."""
+    mesh = _mesh()
+    a = init_dist_attn_runtime_key(
+        [[0, S]], [[0, S]], ["causal"], S, S, CHUNK, mesh=mesh
+    )
+    b = magi_attn_flex_key(
+        [[0, S]], [[0, S]], ["causal"], S, S, mesh=mesh, chunk_size=CHUNK
+    )
+    assert a == b
+
+
+def test_mgr_exposes_metas_and_computes():
+    """The mgr path exposes planning internals AND the same numerics."""
+    mesh = _mesh()
+    mgr = init_dist_attn_runtime_mgr(
+        [[0, S]], [[0, S]], ["causal"], S, S, CHUNK, mesh=mesh
+    )
+    assert mgr.comm_meta is not None and mgr.calc_meta is not None
+    assert len(mgr.dispatch_meta_q.partitions) == 4
+
+    key = mgr.key
+    rng = np.random.default_rng(17)
+    q = jnp.asarray(rng.standard_normal((S, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    out = undispatch(
+        calc_attn(
+            dispatch(q, key), dispatch(k, key, "kv"), dispatch(v, key, "kv"),
+            key,
+        )[0],
+        key,
+    )
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges([[0, S]]), AttnRanges.from_ranges([[0, S]]),
+        [AttnMaskType.CAUSAL], total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+    out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
+
+
+def test_pad_size_applies_padding():
+    """pad_size > 0 pads the mask inside the init (ref keys on pad_size)."""
+    s0 = 200
+    mesh = _mesh()
+    pad = compute_pad_size(s0, 4, CHUNK)
+    key = init_dist_attn_runtime_key(
+        [[0, s0]], [[0, s0]], ["causal"], s0, s0, CHUNK,
+        mesh=mesh, pad_size=pad,
+    )
+    assert key.total_seqlen_q == s0 + pad
+    assert key.q_ranges[-1] == (s0, s0 + pad)
+
+    rng = np.random.default_rng(23)
+    q = pad_at_dim(
+        jnp.asarray(rng.standard_normal((s0, 2, 32)), jnp.float32), 0, pad
+    )
+    k = pad_at_dim(
+        jnp.asarray(rng.standard_normal((s0, 1, 32)), jnp.float32), 0, pad
+    )
+    v = pad_at_dim(
+        jnp.asarray(rng.standard_normal((s0, 1, 32)), jnp.float32), 0, pad
+    )
+    out = unpad_at_dim(
+        undispatch(
+            calc_attn(
+                dispatch(q, key), dispatch(k, key, "kv"),
+                dispatch(v, key, "kv"), key,
+            )[0],
+            key,
+        ),
+        0, s0,
+    )
+    mask = AttnMask.from_ranges(
+        AttnRanges.from_ranges([[0, s0]]), AttnRanges.from_ranges([[0, s0]]),
+        [AttnMaskType.CAUSAL], total_seqlen_q=s0, total_seqlen_k=s0,
+    ).mask_array
+    out_ref, _ = ref_attn(
+        q[:s0], k[:s0], v[:s0], mask, compute_dtype=jnp.float32
+    )
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)

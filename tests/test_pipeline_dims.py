@@ -82,7 +82,10 @@ def test_pipeline_dims(case, cp, hq, hk, d, dtype_tag, backend, backward):
     devs = np.array(jax.devices("cpu")[:cp])
     mesh = jax.sharding.Mesh(devs, axis_names=("cp",))
 
-    rng = np.random.default_rng(hash((case, cp, hq, d)) % 2**31)
+    # stable seed: Python hash() is salted per process, which would make a
+    # marginal-tolerance flake unreproducible
+    rng = np.random.default_rng(CONFIGS.index((case, cp, hq, hk, d,
+                                               dtype_tag, backend, backward)))
     q = jnp.asarray(rng.standard_normal((S, hq, d)), dtype)
     k = jnp.asarray(rng.standard_normal((S, hk, d)), dtype)
     v = jnp.asarray(rng.standard_normal((S, hk, d)), dtype)
