@@ -52,3 +52,13 @@ def ffa_native_plan() -> str:
     loses there), the plan builder is pure array marshalling and wins
     outright, so auto is the default."""
     return _get_str("MAGI_ATTENTION_NATIVE_FFA_PLAN", "auto").lower()
+
+
+def ffa_gqa_pack() -> bool:
+    """Pack the whole GQA query group of one kv head into each fwd grid
+    step (grid (hk, W) instead of (hq, W)): k/v HBM traffic drops by the
+    group factor and per-step bookkeeping amortizes over a taller MXU op.
+    Opt-in until silicon A/B data picks a default; ignored when
+    max-logits output is requested or the packed score tile would
+    overflow VMEM."""
+    return _get_int("MAGI_ATTENTION_FFA_GQA_PACK", 0) == 1

@@ -41,7 +41,7 @@ from ..kernels.ffa import (
     _bwd_plan_slices,
     _ffa_bwd_dkv_pallas,
     _ffa_bwd_dq_pallas,
-    _ffa_fwd_pallas,
+    ffa_fwd_pallas_dispatch,
     _should_interpret,
     ffa_attn_with_plan,
 )
@@ -81,7 +81,9 @@ def _multi_ffa_impl(q, ks, vs, arrays_list, params_list):
         q_t = _head_major(q, sqp)
         k_t = _head_major(k, skp)
         v_t = _head_major(v, skp)
-        out_t, lse_t, ml_p = _ffa_fwd_pallas(prm, *arrs[:3], q_t, k_t, v_t)
+        out_t, lse_t, ml_p = ffa_fwd_pallas_dispatch(
+            prm, *arrs[:3], q_t, k_t, v_t
+        )
         outs.append(out_t.transpose(1, 0, 2)[: q.shape[0]])
         lses.append(lse_t.T[: q.shape[0]])
         ml = ml_p if ml is None else jnp.maximum(ml, ml_p)

@@ -203,13 +203,13 @@ def _ffa_sink_core(q, k, v, sink, arrays, params):
 
 
 def _ffa_sink_fwd_impl(q, k, v, sink, arrays, params):
-    from ..kernels.ffa import _ffa_fwd_pallas
+    from ..kernels.ffa import ffa_fwd_pallas_dispatch
     from .dist_attn import _head_major
     from .sink import apply_sink_fwd
 
     sqp = params.num_q_tiles * params.block_q
     skp = params.num_k_tiles * params.block_k
-    out_t, lse_t, _ = _ffa_fwd_pallas(
+    out_t, lse_t, _ = ffa_fwd_pallas_dispatch(
         params, *arrays[:3],
         _head_major(q, sqp), _head_major(k, skp), _head_major(v, skp),
     )

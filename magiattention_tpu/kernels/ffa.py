@@ -142,7 +142,8 @@ def plan_arrays(plan: FFAPlan) -> tuple[jax.Array, ...]:
 
 
 def _item_mask(
-    meta_ref, w, q_base, k_base, bq: int, bk: int, transposed: bool = False
+    meta_ref, w, q_base, k_base, bq: int, bk: int, transposed: bool = False,
+    repeat: int = 1,
 ):
     """Boolean mask of work item w on the tile at (q_base, k_base).
 
@@ -150,17 +151,27 @@ def _item_mask(
     built directly with swapped iota since Mosaic cannot transpose i1 vectors.
     The scalar is_full flag is OR-ed in (splash's should_not_mask idiom), so
     interior tiles need no separate code path.
+
+    ``repeat`` > 1 (q rows only) emits a vertically-repeated
+    ``(repeat*bq, bk)`` mask — the same q tile stacked for ``repeat``
+    packed heads — via iota-mod rather than an i1 tile (which Mosaic
+    cannot relayout).
     """
     qs, qe = meta_ref[w, QS], meta_ref[w, QE]
     ks, ke = meta_ref[w, KS], meta_ref[w, KE]
     lo, hi = meta_ref[w, DLO], meta_ref[w, DHI]
     full = meta_ref[w, IS_FULL] == 1
     if transposed:
+        assert repeat == 1
         rows = q_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
         cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
     else:
-        rows = q_base + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        shape = (repeat * bq, bk)
+        rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        if repeat > 1:
+            rows = jax.lax.rem(rows, jnp.int32(bq))
+        rows = q_base + rows
+        cols = k_base + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
     in_rect = (rows >= qs) & (rows < qe) & (cols >= ks) & (cols < ke)
     d = cols - rows
     band = in_rect & (d >= lo) & (d <= hi)
@@ -377,6 +388,204 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
     else:
         ml = jnp.full((hq,), NEG_INF, dtype=jnp.float32)
     return out_t, lse_t, ml
+
+
+def _fwd_kernel_gqa(
+    work_qt_ref,
+    work_kt_ref,
+    meta_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    out_ref,
+    lse_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    softcap: float,
+    bq: int,
+    bk: int,
+    g: int,
+):
+    """GQA-packed forward: the whole query group of one kv head per grid
+    step. vs :func:`_fwd_kernel`: grid (hk, W) instead of (hq, W), so each
+    k/v tile is fetched ONCE per work item instead of ``g`` times (k/v HBM
+    traffic /g) and per-step bookkeeping amortizes over a g x taller MXU
+    op. Same online-softmax math on ``g*bq`` packed rows; rows of different
+    heads never interact (the mask repeats per head; softmax is row-wise).
+    """
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    is_full = meta_ref[w, IS_FULL]
+    use_exp2 = softcap == 0.0
+    exp_fn = jnp.exp2 if use_exp2 else jnp.exp
+
+    @pl.when(is_first == 1)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, MASK_VALUE)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    d = q_ref.shape[-1]
+    dv = v_ref.shape[-1]
+    # (g, bq, d) block -> (g*bq, d) packed rows: contiguous sublane merge
+    q = q_ref[0].reshape(g * bq, d)
+    k = k_ref[0]
+    s_raw = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if softcap > 0.0:
+        s_raw = softcap * jnp.tanh(s_raw / softcap)
+
+    def update(s):
+        m_prev = m_scr[...]  # (g*bq, NUM_LANES)
+        m_blk = jnp.max(s, axis=1)[:, None]
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = exp_fn(s - _lane_tile(m_new, bk))
+        alpha = exp_fn(m_prev - m_new)
+        l_new = l_scr[...] * alpha + jnp.sum(p, axis=1)[:, None]
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * _lane_tile(alpha, dv) + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(is_full == 1)
+    def _():
+        update(s_raw)
+
+    @pl.when(is_full == 0)
+    def _():
+        q_base = work_qt_ref[w] * bq
+        k_base = work_kt_ref[w] * bk
+        update(
+            jnp.where(
+                _item_mask(meta_ref, w, q_base, k_base, bq, bk, repeat=g),
+                s_raw,
+                MASK_VALUE,
+            )
+        )
+
+    @pl.when(is_last == 1)
+    def _():
+        m = m_scr[...]
+        l = l_scr[...]
+        empty = m <= EMPTY_THRESH
+        l_safe = jnp.where(empty | (l == 0.0), 1.0, l)
+        o = acc_scr[:] / _lane_tile(l_safe, dv)
+        o = jnp.where(_lane_tile(empty, dv), 0.0, o)
+        out_ref[0] = o.reshape(g, bq, dv).astype(out_ref.dtype)
+        if use_exp2:
+            lse_nat = (m + jnp.log2(l_safe)) * LN2
+        else:
+            lse_nat = m + jnp.log(l_safe)
+        lse_ref[0] = (
+            jnp.where(empty, MASK_VALUE, lse_nat)
+            .reshape(g, bq, NUM_LANES)
+            .astype(jnp.float32)
+        )
+
+
+def _ffa_fwd_pallas_gqa(
+    params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t
+):
+    """GQA-packed forward pallas call (see :func:`_fwd_kernel_gqa`).
+
+    Preconditions (enforced by the caller's dispatch): group > 1,
+    max_logits not requested. Heads of one group are adjacent in q_t
+    (head h uses kv head h // g), so the (hq, sqp, d) -> (hk, g, sqp, d)
+    reshape is free.
+    """
+    bq, bk = params.block_q, params.block_k
+    hq, sqp, d = q_t.shape
+    hk, skp, dv = v_t.shape
+    g = params.group
+    W = params.num_work
+
+    q_scale = params.softmax_scale * (LOG2E if params.softcap == 0.0 else 1.0)
+    q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
+    q_g = q_t.reshape(hk, g, sqp, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(hk, W),
+        in_specs=[
+            pl.BlockSpec(
+                (1, g, bq, d), lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, dv), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, g, bq, dv), lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, g, bq, NUM_LANES),
+                lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g * bq, NUM_LANES), jnp.float32),
+            pltpu.VMEM((g * bq, NUM_LANES), jnp.float32),
+            pltpu.VMEM((g * bq, dv), jnp.float32),
+        ],
+    )
+    kernel = partial(
+        _fwd_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hk, g, sqp, dv), q_t.dtype),
+            jax.ShapeDtypeStruct((hk, g, sqp, NUM_LANES), jnp.float32),
+        ],
+        interpret=params.interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * W * bq * bk * d * hq,
+            bytes_accessed=(q_t.size + k_t.size + v_t.size)
+            * q_t.dtype.itemsize,
+            transcendentals=W * bq * bk * hq,
+        ),
+    )(work_qt, work_kt, meta, q_g, k_t, v_t)
+    out_t = outs[0].reshape(hq, sqp, dv)
+    lse_raw = outs[1].reshape(hq, sqp, NUM_LANES)[..., 0]
+    lse_t = jnp.where(lse_raw <= EMPTY_THRESH, NEG_INF, lse_raw)
+    ml = jnp.full((hq,), NEG_INF, dtype=jnp.float32)
+    return out_t, lse_t, ml
+
+
+def _use_gqa_pack(params: FFAParams) -> bool:
+    """Trace-time dispatch to the packed fwd kernel: opt-in flag, real
+    grouping, no max-logits (the packed kernel doesn't emit them), and a
+    VMEM guard — the packed (g*bq, bk) fp32 score tile must stay well
+    under the ~16 MB VMEM budget."""
+    return (
+        env_kernel.ffa_gqa_pack()
+        and params.group > 1
+        and not params.emit_max_logits
+        and params.group * params.block_q * params.block_k * 4
+        <= 8 * 1024 * 1024
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -763,13 +972,24 @@ def _bwd_plan_slices(arrays: tuple):
     return arrays[0:3], arrays[3:6]
 
 
+def ffa_fwd_pallas_dispatch(params: FFAParams, work_qt, work_kt, meta,
+                            q_t, k_t, v_t):
+    """Forward pallas call with the GQA-packing dispatch applied — the ONE
+    entry every forward path (custom-vjp core, CP multi-stage, sink) uses
+    so the packed kernel is reachable from all of them."""
+    fwd = _ffa_fwd_pallas_gqa if _use_gqa_pack(params) else _ffa_fwd_pallas
+    return fwd(params, work_qt, work_kt, meta, q_t, k_t, v_t)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _ffa_core(q_t, k_t, v_t, arrays, params: FFAParams):
-    return _ffa_fwd_pallas(params, *arrays[0:3], q_t, k_t, v_t)
+    return ffa_fwd_pallas_dispatch(params, *arrays[0:3], q_t, k_t, v_t)
 
 
 def _ffa_core_fwd(q_t, k_t, v_t, arrays, params: FFAParams):
-    out_t, lse_t, ml = _ffa_fwd_pallas(params, *arrays[0:3], q_t, k_t, v_t)
+    out_t, lse_t, ml = ffa_fwd_pallas_dispatch(
+        params, *arrays[0:3], q_t, k_t, v_t
+    )
     res = (q_t, k_t, v_t, out_t, lse_t, arrays)
     return (out_t, lse_t, ml), res
 

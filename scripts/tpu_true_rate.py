@@ -114,6 +114,32 @@ def main():
             print(f"ffa bq{bq} bk{bk}: FAIL {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # -- 2b. GQA-packed fwd A/B (MAGI_ATTENTION_FFA_GQA_PACK) ------------
+    # same shapes, packed grid (hk, W): k/v HBM traffic /g. Env read at
+    # trace time, so set it around body construction only.
+    prev_pack = os.environ.get("MAGI_ATTENTION_FFA_GQA_PACK")
+    os.environ["MAGI_ATTENTION_FFA_GQA_PACK"] = "1"
+    try:
+        for bq, bk in [(512, 512), (1024, 512)]:
+            def ffa_fwd_p(q, bq=bq, bk=bk):
+                return ffa_attn(
+                    q, ks, vs, qr, kr, tm, block_q=bq, block_k=bk
+                )[0].astype(jnp.bfloat16)
+
+            try:
+                ms = do_bench_scan_slope(
+                    ffa_fwd_p, qs, lengths=LENGTHS, verbose=True
+                )
+                record(f"ffa_fwd_gqapack_bq{bq}_bk{bk}", ms, fwd_flops)
+            except Exception as e:
+                print(f"gqapack bq{bq} bk{bk}: FAIL {type(e).__name__}: "
+                      f"{str(e)[:200]}", flush=True)
+    finally:
+        if prev_pack is None:
+            os.environ.pop("MAGI_ATTENTION_FFA_GQA_PACK", None)
+        else:
+            os.environ["MAGI_ATTENTION_FFA_GQA_PACK"] = prev_pack
+
     # -- 3. A/B vs bundled flash_attention (slope, equal heads) ----------
     H = HQ
     ab_flops = 4 * area * D * H

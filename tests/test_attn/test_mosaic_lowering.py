@@ -167,3 +167,35 @@ def test_sink_path_lowers(mosaic):
         return jnp.sum(o.astype(jnp.float32))
 
     _lower_tpu(jax.grad(loss, argnums=(0, 1, 2, 3)), q, k, v, sink)
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+@pytest.mark.parametrize("g", [2, 4])
+def test_gqa_packed_fwd_lowers(mosaic, monkeypatch, dtype, g):
+    """MAGI_ATTENTION_FFA_GQA_PACK=1: the packed (hk, W)-grid fwd kernel
+    (rank-4 q/out blocks, iota-mod repeated mask) must lower to Mosaic."""
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_GQA_PACK", "1")
+    s, hk, d = 2048, 2, 128
+    q, k, v = _mk_inputs(s, hk * g, hk, d, d, dtype)
+    qr, kr, tm = _varlen_meta(s)
+    _lower_tpu(
+        lambda q, k, v: ffa.ffa_attn(
+            q, k, v, qr, kr, tm, block_q=512, block_k=512
+        )[0],
+        q, k, v,
+    )
+
+
+def test_gqa_packed_bwd_lowers(mosaic, monkeypatch):
+    """Packed fwd composes with the (unpacked) bwd kernels under grad."""
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_GQA_PACK", "1")
+    s, hq, hk, d = 2048, 4, 2, 128
+    q, k, v = _mk_inputs(s, hq, hk, d, d, jnp.bfloat16)
+    qr, kr, tm = _varlen_meta(s)
+
+    def loss(q, k, v):
+        o, _ = ffa.ffa_attn(q, k, v, qr, kr, tm, block_q=512, block_k=512)
+        return jnp.sum(o.astype(jnp.float32))
+
+    text = _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+    assert text.count("tpu_custom_call") >= 3
