@@ -48,13 +48,25 @@ run_step() {  # run_step <timeout> <logfile> <cmd...>
 
 run_queue() {
   TS=$(date -u +%m%d_%H%M)
-  run_step 900 ".tpu_logs/${TS}_smoke.log" python -u scripts/tpu_smoke.py || return
-  # slope-timed (launch-overhead-free) ceiling + kernel rates FIRST: the
-  # 2026-07-31 calibration showed every length-6-scan number is dominated
-  # by the tunnel's ~170 ms fixed per-launch cost — these are the numbers
-  # the round actually needs
-  run_step 1800 ".tpu_logs/${TS}_true_rate.log" python -u scripts/tpu_true_rate.py || return
+  # Windows can close after ~4 min (03:17 window died inside step 2), so
+  # order strictly by value-per-minute: the headline bench number first
+  # (it is also what the driver's round-end bench.py re-runs, so its
+  # compiles land in the persistent cache), then the slope-timed
+  # ceiling/A-B probes, then correctness smoke — which is skipped when it
+  # already passed for the current kernel sources (stamp file).
   run_step 1500 ".tpu_logs/${TS}_bench.log" python -u bench.py || return
+  run_step 1800 ".tpu_logs/${TS}_true_rate.log" python -u scripts/tpu_true_rate.py || return
+  # stamp covers the whole package (smoke's correctness surface includes
+  # common/, env/, testing/ imports) + the smoke script + the queue's own
+  # env flags; any package edit re-arms the smoke
+  KHASH=$( (find magiattention_tpu -name '*.py' -print0 | sort -z | xargs -0 cat; cat scripts/tpu_smoke.py; env | grep '^MAGI_ATTENTION_' | sort) 2>/dev/null | md5sum | cut -d' ' -f1)
+  SMOKE_STAMP=".tpu_logs/smoke_pass_${KHASH}"
+  if [ -f "$SMOKE_STAMP" ]; then
+    echo "[$(date -u +%H:%M:%S)] smoke already passed for kernels ${KHASH:0:8} — skip" >> "$LOG"
+  else
+    run_step 900 ".tpu_logs/${TS}_smoke.log" python -u scripts/tpu_smoke.py || return
+    grep -q "^SMOKE PASS" ".tpu_logs/${TS}_smoke.log" && touch "$SMOKE_STAMP"
+  fi
   run_step 2400 ".tpu_logs/${TS}_probe.log" python -u scripts/tpu_perf_probe.py || return
   run_step 2400 ".tpu_logs/${TS}_grid.log" python -u benchmarks/kernel_bench.py \
     --seqlens 4096,8192,32768 --backward || return
