@@ -6,3 +6,10 @@ workload, ref README.md:54-56)."""
 
 from .llama import LlamaConfig, forward, init_params, train_step  # noqa: F401
 from . import video_dit  # noqa: F401
+from .moe import (  # noqa: F401
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_train_step,
+    shard_moe_params,
+)

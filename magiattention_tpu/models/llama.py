@@ -129,6 +129,34 @@ def _rope(x, pos, theta):
     ).astype(x.dtype)
 
 
+def attn_block(x, lyr, cfg, pos, attn_key):
+    """Pre-norm attention sub-block on the dispatched layout (shared by the
+    Llama and MoE families — ONE source of truth for qkv/rope/CP-attn/wo)."""
+    dt = x.dtype
+    h = _rms_norm(x, lyr["attn_norm"], cfg.norm_eps)
+    q = (h @ lyr["wq"].astype(dt)).reshape(-1, cfg.n_heads, cfg.head_dim)
+    k = (h @ lyr["wk"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lyr["wv"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+    q = _rope(q, pos, cfg.rope_theta)
+    k = _rope(k, pos, cfg.rope_theta)
+    attn_out, _ = calc_attn(q, k, v, attn_key)
+    attn_out = attn_out.reshape(-1, cfg.n_heads * cfg.head_dim)
+    return x + attn_out @ lyr["wo"].astype(dt)
+
+
+def masked_ce(logits, labels):
+    """Mean cross entropy over positions with ``labels >= 0`` (ignored
+    positions clamped before the gather so no wrapped index is read)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, jnp.maximum(labels, 0)[:, None], axis=-1
+    )[:, 0]
+    valid = labels >= 0
+    return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+        jnp.sum(valid), 1
+    )
+
+
 def forward(
     params: dict,
     cfg: LlamaConfig,
@@ -151,16 +179,7 @@ def forward(
     pos = get_position_ids(attn_key)
 
     def layer(x, lyr):
-        h = _rms_norm(x, lyr["attn_norm"], cfg.norm_eps)
-        q = (h @ lyr["wq"].astype(dt)).reshape(-1, cfg.n_heads, cfg.head_dim)
-        k = (h @ lyr["wk"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lyr["wv"].astype(dt)).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-        q = _rope(q, pos, cfg.rope_theta)
-        k = _rope(k, pos, cfg.rope_theta)
-        attn_out, _ = calc_attn(q, k, v, attn_key)
-        attn_out = attn_out.reshape(-1, cfg.n_heads * cfg.head_dim)
-        x = x + attn_out @ lyr["wo"].astype(dt)
-
+        x = attn_block(x, lyr, cfg, pos, attn_key)
         h = _rms_norm(x, lyr["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ lyr["w_gate"].astype(dt))
         up = h @ lyr["w_up"].astype(dt)
@@ -188,12 +207,7 @@ def loss_fn(
     the logits)."""
     logits = forward(params, cfg, tokens, attn_key)
     labels_d = dispatch(labels, attn_key)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels_d[:, None], axis=-1)[:, 0]
-    valid = labels_d >= 0
-    return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
-        jnp.sum(valid), 1
-    )
+    return masked_ce(logits, labels_d)
 
 
 @partial(jax.jit, static_argnums=(1, 4), donate_argnums=(0,))

@@ -1,0 +1,362 @@
+"""Mixture-of-Experts transformer with expert parallelism (EP) over the mesh.
+
+The reference delegates MoE/expert parallelism to Megatron-LM (ref
+examples/megatron/README.md — SURVEY §2.8 lists TP/PP/EP as "delegated to
+Megatron; not implemented in-repo"); the TPU build provides it natively so
+the CP attention engine composes with an in-framework MoE model family.
+
+TPU-first design (GShard/Switch capacity routing, the canonical XLA MoE):
+
+- **Static shapes everywhere.** Top-k routing lowers to one-hot matmuls and
+  a cumsum-based position-in-expert assignment; each expert processes a
+  fixed ``capacity`` of token slots per shard. Overflowing tokens are
+  dropped (their combine weight is 0, the residual stream carries them
+  unchanged) — no dynamic shapes reach XLA, so everything tiles onto the
+  MXU.
+- **EP = ``lax.all_to_all`` over a mesh axis.** Experts are sharded over the
+  ``ep`` axis (which may be the same devices as the cp/dp axis — the
+  DeepSpeed-MoE "expert-parallel group == data-parallel group" layout).
+  Token slots travel shard -> expert shard and back with two all_to_alls
+  riding ICI, exactly the comm pattern the reference's grpcoll a2av tier
+  uses for KV (comm/primitives.py) — here it is the *token* payload.
+- **Batched expert matmuls.** The per-shard expert FFN is a single
+  ``(E_local, tokens, dim) x (E_local, dim, ffn)`` einsum — one batched MXU
+  op, not a Python loop over experts.
+
+Gating math follows Mixtral (softmax over selected top-k logits); auxiliary
+load-balancing loss follows Switch Transformer (mean fraction x mean prob).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..api import dispatch, get_position_ids
+from ..dist_attn_runtime_mgr import DistAttnRuntimeKey
+from .llama import LlamaConfig, _rms_norm, attn_block, masked_ce
+
+
+@dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    """Llama backbone with MoE FFN layers (attention path unchanged)."""
+
+    n_experts: int = 8
+    top_k: int = 2
+    # per-expert token slots per EP shard = ceil(top_k * S_shard / E) * cf
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array) -> dict:
+    """Parameter pytree: llama backbone, MoE FFN per layer.
+
+    Expert weights are stacked on a leading ``n_experts`` dim so they shard
+    over the ep axis with a plain ``P('ep', ...)`` annotation.
+    """
+    ks = jax.random.split(key, 2 + cfg.n_layers)
+    dim, dh, ffn = cfg.dim, cfg.head_dim, cfg.ffn_hidden
+    hq, hk = cfg.n_heads, cfg.n_kv_heads
+    E = cfg.n_experts
+
+    def dense(k, shape, fan_in):
+        return jax.random.normal(k, shape, dtype=jnp.float32) * (
+            fan_in ** -0.5
+        )
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(ks[2 + i], 9)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((dim,), jnp.float32),
+                "wq": dense(lk[0], (dim, hq * dh), dim),
+                "wk": dense(lk[1], (dim, hk * dh), dim),
+                "wv": dense(lk[2], (dim, hk * dh), dim),
+                "wo": dense(lk[3], (hq * dh, dim), hq * dh),
+                "mlp_norm": jnp.ones((dim,), jnp.float32),
+                "router": dense(lk[4], (dim, E), dim),
+                "w_gate": dense(lk[5], (E, dim, ffn), dim),
+                "w_up": dense(lk[6], (E, dim, ffn), dim),
+                "w_down": dense(lk[7], (E, ffn, dim), ffn),
+            }
+        )
+    return {
+        "embed": dense(ks[0], (cfg.vocab_size, dim), dim),
+        "final_norm": jnp.ones((dim,), jnp.float32),
+        "lm_head": dense(ks[1], (dim, cfg.vocab_size), dim),
+        "layers": layers,
+    }
+
+
+def shard_moe_params(
+    params: dict, mesh: Mesh, dp_axis: str = "cp", ep_axis: str | None = None
+) -> dict:
+    """ZeRO-3 first-dim sharding over dp/cp + expert sharding over ep.
+
+    Expert-stacked weights (leading dim ``n_experts``) shard their expert
+    dim over ``ep_axis``; everything else follows llama's ZeRO-3 layout.
+    ``ep_axis`` may equal ``dp_axis`` (expert-parallel group == data-
+    parallel group).
+    """
+    ep = mesh.shape[ep_axis] if ep_axis else 1
+
+    def s2(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    def s(path, x):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("w_gate", "w_up", "w_down") and x.ndim == 3:
+            if ep_axis:
+                if x.shape[0] % ep:
+                    raise ValueError(
+                        f"n_experts={x.shape[0]} must be divisible by the "
+                        f"ep axis size {ep} (mesh axis {ep_axis!r})"
+                    )
+                return s2(x, P(ep_axis, None, None))
+            return s2(x, P())
+        dp_ok = x.ndim >= 2 and x.shape[0] % mesh.shape[dp_axis] == 0
+        if dp_ok:
+            return s2(x, P(dp_axis, *([None] * (x.ndim - 1))))
+        return s2(x, P())
+
+    return jax.tree_util.tree_map_with_path(s, params)
+
+
+# ---------------------------------------------------------------------------
+# the MoE FFN layer
+# ---------------------------------------------------------------------------
+
+
+def _route(h32, router_w, cfg: MoEConfig):
+    """Top-k routing tensors for one shard's tokens.
+
+    Returns (dispatch ``(S, E, C)`` bool-as-dtype one-hot, combine
+    ``(S, E, C)`` probs, aux load-balance loss scalar).
+    """
+    S = h32.shape[0]
+    E, K = cfg.n_experts, cfg.top_k
+    C = int(np.ceil(K * S / E * cfg.capacity_factor))
+    logits = h32 @ router_w  # (S, E) fp32
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Mixtral gating: softmax over the selected top-k logits
+    topv, topi = jax.lax.top_k(logits, K)  # (S, K)
+    gates = jax.nn.softmax(topv, axis=-1)  # (S, K)
+
+    # Switch aux loss: E * mean_frac_per_expert . mean_prob_per_expert
+    sel1 = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
+    aux = E * jnp.mean(sel1, axis=0) @ jnp.mean(probs, axis=0)
+
+    # position-in-expert via cumsum over the flattened (K, S) priority
+    # order: k=0 choices of all tokens beat k=1 choices (GShard's policy).
+    # Top-k indices are distinct per token, so each (s, e) pair appears at
+    # most once across K — sum over K *before* the one-hot over C, keeping
+    # the big tensor at (S, E, C) instead of (K, S, E, C).
+    onehot = jax.nn.one_hot(topi.T, E, dtype=jnp.float32)  # (K, S, E)
+    flat = onehot.reshape(K * S, E)
+    pos = jnp.cumsum(flat, axis=0) - flat  # slots before this entry
+    pos = pos.reshape(K, S, E)
+    keep = flat.reshape(K, S, E) * (pos < C)  # (K, S, E)
+    sel = jnp.sum(keep, axis=0)  # (S, E) — 0/1
+    pos_se = jnp.sum(pos * keep, axis=0)  # (S, E) — slot when sel
+    gate_se = jnp.einsum("sk,kse->se", gates, keep)
+    posc = jax.nn.one_hot(
+        pos_se.astype(jnp.int32), C, dtype=jnp.float32
+    )  # (S, E, C)
+    dispatch_t = sel[..., None] * posc  # (S, E, C) — one slot per (s, e)
+    combine = gate_se[..., None] * posc
+    return dispatch_t, combine, aux
+
+
+def _moe_ffn_local(
+    h, router, w_gate, w_up, w_down, cfg: MoEConfig,
+    ep_axis: str | None, ep: int,
+):
+    """MoE FFN on one shard's tokens ``h: (S_local, dim)``.
+
+    Runs inside shard_map when ``ep_axis`` is set: expert weights arrive
+    ep-sharded ``(E/ep, dim, ffn)``; token slots all_to_all to the expert
+    shards and back. With ``ep_axis=None`` (single shard) the all_to_alls
+    vanish and the full expert stack is local. Expert id convention:
+    ``e = ep_rank * (E // ep) + e_local`` (shard p owns the p-th expert
+    block).
+    """
+    dt = h.dtype
+    h32 = h.astype(jnp.float32)
+    dispatch_t, combine, aux = _route(h32, router, cfg)
+    S, E, C = dispatch_t.shape
+
+    # gather token slots: (E, C, dim)
+    slots = jnp.einsum("seC,sd->eCd", dispatch_t.astype(dt), h)
+
+    if ep_axis is not None and ep > 1:
+        # send each peer its expert block's slots; receive my block's
+        # slots from every peer. all_to_all(tiled=False, split 0, concat
+        # 0) yields (ep=source_peer, E/ep, C, d); batch experts, stack
+        # source peers into the slot axis: (E/ep, ep*C, d).
+        recv = jax.lax.all_to_all(
+            slots.reshape(ep, E // ep, C, -1), ep_axis,
+            split_axis=0, concat_axis=0, tiled=False,
+        )
+        slots = recv.transpose(1, 0, 2, 3).reshape(E // ep, ep * C, -1)
+        aux = jax.lax.pmean(aux, ep_axis)
+
+    # batched expert FFN: one einsum per projection (E_local batched matmul)
+    g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", slots, w_gate.astype(dt)))
+    u = jnp.einsum("ecd,edf->ecf", slots, w_up.astype(dt))
+    out = jnp.einsum("ecf,efd->ecd", g * u, w_down.astype(dt))
+
+    if ep_axis is not None and ep > 1:
+        # inverse of the forward exchange: (E/ep, ep*C, d) -> split the
+        # slot axis back by token-owner peer -> (ep, E/ep, C, d) -> a2a
+        # -> (ep=expert_shard, E/ep, C, d) -> (E, C, d)
+        send = out.reshape(E // ep, ep, C, -1).swapaxes(0, 1)
+        out = jax.lax.all_to_all(
+            send, ep_axis, split_axis=0, concat_axis=0, tiled=False,
+        ).reshape(E, C, -1)
+
+    y = jnp.einsum("seC,eCd->sd", combine.astype(dt), out)
+    return y, aux
+
+
+def moe_ffn(h, lyr, cfg: MoEConfig, mesh=None, ep_axis=None):
+    """Public MoE FFN entry.
+
+    - ``mesh`` given: wraps itself in a shard_map over ``ep_axis`` (tokens
+      sharded over the same axis — the expert-parallel group == data/cp
+      group layout).
+    - ``mesh=None, ep_axis`` given: already inside a shard_map; uses the
+      bound axis name directly.
+    - both None: single-shard (no comm).
+    """
+    args = (lyr["router"], lyr["w_gate"], lyr["w_up"], lyr["w_down"])
+    if mesh is None:
+        ep = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
+        if cfg.n_experts % ep:
+            raise ValueError(
+                f"n_experts={cfg.n_experts} must be divisible by the ep "
+                f"axis size {ep}"
+            )
+        return _moe_ffn_local(h, *args, cfg, ep_axis, ep)
+    ep = mesh.shape[ep_axis]
+    if cfg.n_experts % ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} must be divisible by the ep axis "
+            f"size {ep} (mesh axis {ep_axis!r})"
+        )
+    fn = jax.shard_map(
+        partial(_moe_ffn_local, cfg=cfg, ep_axis=ep_axis, ep=ep),
+        mesh=mesh,
+        in_specs=(
+            P(ep_axis),  # tokens
+            P(),  # router (replicated)
+            P(ep_axis), P(ep_axis), P(ep_axis),  # expert-stacked weights
+        ),
+        out_specs=(P(ep_axis), P()),
+    )
+    return fn(h, *args)
+
+
+# ---------------------------------------------------------------------------
+# full model: llama backbone + MoE FFN
+# ---------------------------------------------------------------------------
+
+
+def moe_forward(
+    params: dict,
+    cfg: MoEConfig,
+    tokens: jax.Array,
+    attn_key: DistAttnRuntimeKey,
+    ep_axis: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Forward on the dispatched CP layout; MoE FFN with optional EP.
+
+    When ``ep_axis`` is given the caller must run this under pjit on a mesh
+    carrying that axis; the MoE layer's shard_map boundary is established
+    per layer against the dispatched token shard. Returns
+    ``(logits_dispatched, aux_loss)``.
+    """
+    dt = cfg.jdtype
+    x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
+    x = dispatch(x, attn_key)
+    pos = get_position_ids(attn_key)
+    if ep_axis is not None:
+        from ..api.magi_attn_interface import _mgr
+
+        mesh = _mgr(attn_key).mesh
+    else:
+        mesh = None
+
+    aux_total = jnp.zeros((), jnp.float32)
+
+    def layer(x, lyr):
+        x = attn_block(x, lyr, cfg, pos, attn_key)
+        h = _rms_norm(x, lyr["mlp_norm"], cfg.norm_eps)
+        y, aux = moe_ffn(h, lyr, cfg, mesh=mesh, ep_axis=ep_axis)
+        return x + y, aux
+
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+
+    for lyr in params["layers"]:
+        x, aux = layer(x, lyr)
+        aux_total = aux_total + aux
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, aux_total / max(cfg.n_layers, 1)
+
+
+def moe_loss_fn(params, cfg, tokens, labels, attn_key, ep_axis=None):
+    logits, aux = moe_forward(params, cfg, tokens, attn_key, ep_axis)
+    labels_d = dispatch(labels, attn_key)
+    return masked_ce(logits, labels_d) + cfg.aux_loss_coef * aux
+
+
+@partial(jax.jit, static_argnums=(1, 4, 5), donate_argnums=(0,))
+def moe_train_step(
+    params, cfg: MoEConfig, tokens, labels, attn_key, ep_axis=None,
+    lr: float = 1e-4,
+):
+    loss, grads = jax.value_and_grad(moe_loss_fn)(
+        params, cfg, tokens, labels, attn_key, ep_axis
+    )
+    params = jax.tree.map(
+        lambda p, g: p - lr * g.astype(p.dtype), params, grads
+    )
+    return params, loss
+
+
+# ---------------------------------------------------------------------------
+# dense reference (testing): per-token full expert sum, no capacity drops
+# ---------------------------------------------------------------------------
+
+
+def moe_ffn_reference(h, lyr, cfg: MoEConfig):
+    """O(S*E) dense reference of the MoE FFN — every token visits its top-k
+    experts directly (no capacity, no drops). Ground truth for the routed
+    implementation wherever no slot overflows."""
+    h32 = h.astype(jnp.float32)
+    logits = h32 @ lyr["router"]
+    topv, topi = jax.lax.top_k(logits, cfg.top_k)
+    gates = jax.nn.softmax(topv, axis=-1)  # (S, K)
+    dt = h.dtype
+
+    def expert(e, x):
+        g = jax.nn.silu(x @ lyr["w_gate"][e].astype(dt))
+        u = x @ lyr["w_up"][e].astype(dt)
+        return (g * u) @ lyr["w_down"][e].astype(dt)
+
+    all_out = jnp.stack(
+        [expert(e, h) for e in range(cfg.n_experts)], axis=1
+    )  # (S, E, dim)
+    sel = jnp.take_along_axis(
+        all_out, topi[:, :, None], axis=1
+    )  # (S, K, dim)
+    return jnp.sum(sel * gates[:, :, None].astype(dt), axis=1)

@@ -3,8 +3,10 @@
 # its own — run it in the background and kill it when done.
 #
 # Probes the tunnel TPU every 2 minutes with a short-timeout matmul. On
-# every responsive window it runs the experiment queue (smoke -> bench ->
-# block sweep -> 6-mask kernel grid -> profiler trace), logging into
+# every responsive window it runs the experiment queue (headline bench ->
+# slope-timed true-rate probes -> smoke [skipped when the package-hash
+# stamp says it already passed] -> block sweep -> 6-mask kernel grid ->
+# profiler trace), logging into
 # timestamped files so each window appends to the history rather than
 # overwriting the last one. Windows are ~10 min, so after a window closes
 # it keeps probing every 2 min (kernels change during the round; every

@@ -1,0 +1,185 @@
+"""MoE model family + expert parallelism (models/moe.py).
+
+The reference delegates MoE/EP to Megatron (SURVEY §2.8); these tests pin
+the TPU-native replacement: GShard capacity routing == dense per-token
+reference wherever no slot overflows, EP all_to_all == single-shard
+routing bit-for-bit (same capacity), and the full CP x EP model trains.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from magiattention_tpu.api import magi_attn_flex_key, undispatch
+from magiattention_tpu.models import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_train_step,
+    shard_moe_params,
+)
+from magiattention_tpu.models.moe import (
+    _moe_ffn_local,
+    moe_ffn,
+    moe_ffn_reference,
+)
+
+CFG = MoEConfig(
+    vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    head_dim=16, ffn_hidden=96, dtype="float32",
+    n_experts=8, top_k=2,
+)
+S = 128
+
+
+def _layer_params(key=0):
+    return init_moe_params(CFG, jax.random.key(key))["layers"][0]
+
+
+def _tokens_h(seed=0, s=S):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((s, CFG.dim)), jnp.float32)
+
+
+def test_routed_matches_dense_reference_when_capacity_ample():
+    """With capacity >= every expert's true load, routed == reference."""
+    cfg = dataclasses.replace(CFG, capacity_factor=8.0)  # C == top_k * S / E * 8 >= S
+    lyr = _layer_params()
+    h = _tokens_h()
+    y_routed, aux = _moe_ffn_local(
+        h, lyr["router"], lyr["w_gate"], lyr["w_up"], lyr["w_down"],
+        cfg, None, 1,
+    )
+    y_ref = moe_ffn_reference(h, lyr, cfg)
+    np.testing.assert_allclose(
+        np.asarray(y_routed), np.asarray(y_ref), atol=1e-5, rtol=1e-5
+    )
+    assert float(aux) > 0.0
+
+
+def test_capacity_drops_zero_out_overflow_tokens():
+    """capacity_factor -> tiny: dropped tokens contribute exactly 0 (the
+    residual carries them); kept slots still match the reference rows."""
+    cfg = dataclasses.replace(CFG, capacity_factor=0.25, top_k=1)
+    lyr = _layer_params()
+    h = _tokens_h()
+    y_routed, _ = _moe_ffn_local(
+        h, lyr["router"], lyr["w_gate"], lyr["w_up"], lyr["w_down"],
+        cfg, None, 1,
+    )
+    y_ref = moe_ffn_reference(h, lyr, cfg)
+    y_r = np.asarray(y_routed)
+    y_d = np.asarray(y_ref)
+    # every row either matches the reference (kept) or is exactly zero
+    # (dropped); with cf=0.25 some row of each kind must exist
+    match = np.isclose(y_r, y_d, atol=1e-5, rtol=1e-5).all(axis=1)
+    zero = (y_r == 0.0).all(axis=1)
+    assert np.all(match | zero)
+    assert match.any() and zero.any()
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_ep_all_to_all_matches_single_shard(ep):
+    """shard_map EP over the virtual mesh == the no-comm path, exactly.
+
+    Per-shard routing with S_local = S/ep must equal running the same
+    shard's tokens through a single-shard MoE with the same capacity —
+    the all_to_alls are pure data movement.
+    """
+    mesh = Mesh(np.array(jax.devices("cpu")[:ep]), axis_names=("ep",))
+    lyr = _layer_params()
+    h = _tokens_h()
+
+    y_ep, aux_ep = jax.jit(
+        lambda h: moe_ffn(h, lyr, CFG, mesh=mesh, ep_axis="ep")
+    )(h)
+
+    # reference: each shard independently, full expert stack local
+    outs, auxs = [], []
+    for p in range(ep):
+        hp = h[p * (S // ep):(p + 1) * (S // ep)]
+        y, a = _moe_ffn_local(
+            hp, lyr["router"], lyr["w_gate"], lyr["w_up"], lyr["w_down"],
+            CFG, None, 1,
+        )
+        outs.append(np.asarray(y))
+        auxs.append(float(a))
+    np.testing.assert_allclose(
+        np.asarray(y_ep), np.concatenate(outs), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_allclose(float(aux_ep), np.mean(auxs), rtol=1e-6)
+
+
+def test_ep_gradients_flow_through_all_to_all():
+    ep = 4
+    mesh = Mesh(np.array(jax.devices("cpu")[:ep]), axis_names=("ep",))
+    lyr = _layer_params()
+    h = _tokens_h()
+
+    def loss(lyr, h):
+        y, aux = moe_ffn(h, lyr, CFG, mesh=mesh, ep_axis="ep")
+        return jnp.sum(y * y) + aux
+
+    grads = jax.jit(jax.grad(loss))(lyr, h)
+    flat, _ = jax.tree_util.tree_flatten(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+    # expert weights that received tokens must have nonzero grads
+    assert float(jnp.abs(grads["w_down"]).sum()) > 0.0
+    assert float(jnp.abs(grads["router"]).sum()) > 0.0
+
+
+def _make_key(cp):
+    mesh = Mesh(np.array(jax.devices("cpu")[:cp]), axis_names=("cp",))
+    key = magi_attn_flex_key(
+        [[0, S // 2], [S // 2, S]],
+        [[0, S // 2], [S // 2, S]],
+        [1, 1], S, S, mesh=mesh, chunk_size=16,
+    )
+    return mesh, key
+
+
+def test_moe_forward_matches_across_cp():
+    """Full model: cp=1 == cp=4 with EP over the cp axis (ample capacity —
+    per-shard routing is capacity-local, so drops differ across layouts
+    unless capacity is ample)."""
+    cfg = dataclasses.replace(CFG, capacity_factor=8.0)
+    params = init_moe_params(cfg, jax.random.key(0))
+    tokens = np.arange(S, dtype=np.int32) % cfg.vocab_size
+
+    _, key1 = _make_key(1)
+    logits1, aux1 = moe_forward(params, cfg, jnp.asarray(tokens), key1)
+    logits1 = undispatch(logits1, key1)
+
+    _, key4 = _make_key(4)
+    logits4, aux4 = moe_forward(
+        params, cfg, jnp.asarray(tokens), key4, ep_axis="cp"
+    )
+    logits4 = undispatch(logits4, key4)
+    np.testing.assert_allclose(
+        np.asarray(logits1), np.asarray(logits4), atol=5e-4, rtol=5e-4
+    )
+    # aux is a per-EP-group statistic (mean of per-shard frac.prob
+    # products), so cp=4 legitimately differs from the cp=1 global value —
+    # assert both are finite, positive, O(1) balance numbers
+    assert 0.0 < float(aux1) < 10.0 and 0.0 < float(aux4) < 10.0
+
+
+def test_moe_train_step_decreases_loss():
+    mesh, key = _make_key(4)
+    params = init_moe_params(CFG, jax.random.key(0))
+    params = shard_moe_params(params, mesh, dp_axis="cp", ep_axis="cp")
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, CFG.vocab_size, S).astype(np.int32)
+    labels = np.concatenate([tokens[1:], [-1]]).astype(np.int32)
+    losses = []
+    for _ in range(3):
+        params, loss = moe_train_step(
+            params, CFG, tokens, labels, key, "cp", lr=1e-2
+        )
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
