@@ -326,6 +326,13 @@ def get_position_ids(key: DistAttnRuntimeKey) -> jax.Array:
     return _mgr(key).get_position_ids()
 
 
+def get_mesh(key: DistAttnRuntimeKey):
+    """The ``jax.sharding.Mesh`` the key's runtime was planned for (model
+    code composing further parallelism — e.g. expert-parallel shard_maps —
+    needs the mesh back from the key)."""
+    return _mgr(key).mesh
+
+
 def get_most_recent_key() -> DistAttnRuntimeKey | None:
     return _most_recent_key
 

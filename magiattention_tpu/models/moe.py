@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..api import dispatch, get_position_ids
+from ..api import dispatch, get_mesh, get_position_ids
 from ..dist_attn_runtime_mgr import DistAttnRuntimeKey
 from .llama import LlamaConfig, _rms_norm, attn_block, masked_ce
 
@@ -94,6 +94,14 @@ def init_moe_params(cfg: MoEConfig, key: jax.Array) -> dict:
     }
 
 
+def _check_experts_divisible(n_experts: int, ep: int, ep_axis) -> None:
+    if ep and n_experts % ep:
+        raise ValueError(
+            f"n_experts={n_experts} must be divisible by the ep axis size "
+            f"{ep} (axis {ep_axis!r})"
+        )
+
+
 def shard_moe_params(
     params: dict, mesh: Mesh, dp_axis: str = "cp", ep_axis: str | None = None
 ) -> dict:
@@ -113,12 +121,12 @@ def shard_moe_params(
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
         if name in ("w_gate", "w_up", "w_down") and x.ndim == 3:
             if ep_axis:
-                if x.shape[0] % ep:
-                    raise ValueError(
-                        f"n_experts={x.shape[0]} must be divisible by the "
-                        f"ep axis size {ep} (mesh axis {ep_axis!r})"
-                    )
+                _check_experts_divisible(x.shape[0], ep, ep_axis)
                 return s2(x, P(ep_axis, None, None))
+            # no EP: ZeRO-3 the expert dim over dp like every other
+            # first-dim-shardable weight (experts are the dominant params)
+            if x.shape[0] % mesh.shape[dp_axis] == 0:
+                return s2(x, P(dp_axis, None, None))
             return s2(x, P())
         dp_ok = x.ndim >= 2 and x.shape[0] % mesh.shape[dp_axis] == 0
         if dp_ok:
@@ -238,18 +246,10 @@ def moe_ffn(h, lyr, cfg: MoEConfig, mesh=None, ep_axis=None):
     args = (lyr["router"], lyr["w_gate"], lyr["w_up"], lyr["w_down"])
     if mesh is None:
         ep = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
-        if cfg.n_experts % ep:
-            raise ValueError(
-                f"n_experts={cfg.n_experts} must be divisible by the ep "
-                f"axis size {ep}"
-            )
+        _check_experts_divisible(cfg.n_experts, ep, ep_axis)
         return _moe_ffn_local(h, *args, cfg, ep_axis, ep)
     ep = mesh.shape[ep_axis]
-    if cfg.n_experts % ep:
-        raise ValueError(
-            f"n_experts={cfg.n_experts} must be divisible by the ep axis "
-            f"size {ep} (mesh axis {ep_axis!r})"
-        )
+    _check_experts_divisible(cfg.n_experts, ep, ep_axis)
     fn = jax.shard_map(
         partial(_moe_ffn_local, cfg=cfg, ep_axis=ep_axis, ep=ep),
         mesh=mesh,
@@ -286,12 +286,7 @@ def moe_forward(
     x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
     x = dispatch(x, attn_key)
     pos = get_position_ids(attn_key)
-    if ep_axis is not None:
-        from ..api.magi_attn_interface import _mgr
-
-        mesh = _mgr(attn_key).mesh
-    else:
-        mesh = None
+    mesh = get_mesh(attn_key) if ep_axis is not None else None
 
     aux_total = jnp.zeros((), jnp.float32)
 

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from magiattention_tpu.api import magi_attn_flex_key, undispatch
 from magiattention_tpu.models import (
