@@ -168,6 +168,28 @@ def test_moe_forward_matches_across_cp():
     assert 0.0 < float(aux1) < 10.0 and 0.0 < float(aux4) < 10.0
 
 
+def test_moe_train_step_no_ep_zero3_sharding():
+    """ep_axis=None: expert stacks ZeRO-shard their expert dim over dp and
+    the no-comm moe_ffn path still trains (the non-EP branch of
+    shard_moe_params)."""
+    mesh, key = _make_key(4)
+    params = init_moe_params(CFG, jax.random.key(0))
+    params = shard_moe_params(params, mesh, dp_axis="cp")  # no ep_axis
+    wg = params["layers"][0]["w_gate"]
+    assert "cp" in str(wg.sharding.spec), wg.sharding.spec
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, CFG.vocab_size, S).astype(np.int32)
+    labels = np.concatenate([tokens[1:], [-1]]).astype(np.int32)
+    losses = []
+    for _ in range(3):
+        params, loss = moe_train_step(
+            params, CFG, tokens, labels, key, None, lr=1e-2
+        )
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
 def test_moe_train_step_decreases_loss():
     mesh, key = _make_key(4)
     params = init_moe_params(CFG, jax.random.key(0))

@@ -94,12 +94,16 @@ def _sampled_dense_backend(rows: np.ndarray):
     return backend
 
 
-@pytest.mark.slow
-def test_baseline_config3_cp8_262k_numeric(monkeypatch):
+def _run_sampled_pipeline(monkeypatch, seed, qr, kr, tm, s, cp, chunk,
+                          oracle_cols):
+    """The shared config-3/4 recipe: sampled-row dense backend, full
+    pipeline at scale, per-sampled-row fp64 oracle over ``oracle_cols(i)``
+    (the global key columns row i attends). ONE implementation so backend
+    patch point, sample-identification and tolerances cannot diverge."""
     monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "sdpa")
     H, D = 2, 32
-    shard = S3 // CP3
-    rng = np.random.default_rng(0)
+    shard = s // cp
+    rng = np.random.default_rng(seed)
     # identical local sample rows on every rank: shard boundaries (the
     # rows most likely to expose off-by-one dispatch/comm index errors)
     # + randoms; global identity recovered from the finite-lse pattern
@@ -111,17 +115,16 @@ def test_baseline_config3_cp8_262k_numeric(monkeypatch):
 
     monkeypatch.setattr(sdpa_mod, "sdpa_attn", _sampled_dense_backend(rows))
 
-    mesh = Mesh(np.array(jax.devices("cpu")[:CP3]), ("cp",))
+    mesh = Mesh(np.array(jax.devices("cpu")[:cp]), ("cp",))
     t0 = time.perf_counter()
     key = magi_attn_flex_key(
-        [[0, S3]], [[0, S3]], [1], S3, S3,
-        mesh=mesh, cp_axis="cp", chunk_size=2048,
+        qr, kr, tm, s, s, mesh=mesh, cp_axis="cp", chunk_size=chunk,
     )
     plan_s = time.perf_counter() - t0
 
-    q = jnp.asarray(rng.standard_normal((S3, H, D)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((S3, H, D)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((S3, H, D)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((s, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((s, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((s, H, D)), jnp.float32)
 
     qd = dispatch(q, key)
     kd = dispatch(k, key, role="kv")
@@ -131,19 +134,21 @@ def test_baseline_config3_cp8_262k_numeric(monkeypatch):
     lse = np.asarray(undispatch(meta.lse, key))
 
     sample = np.flatnonzero(np.isfinite(lse[:, 0]))
-    assert len(sample) == CP3 * len(rows), (len(sample), len(rows))
+    assert len(sample) == cp * len(rows), (len(sample), len(rows))
 
     kn = np.asarray(k, np.float64)
     vn = np.asarray(v, np.float64)
     qn = np.asarray(q, np.float64)
     scale = D ** -0.5
     for i in sample:
+        cols = oracle_cols(i)
+        assert len(cols), i
         for h in range(H):
-            logits = kn[: i + 1, h % H] @ qn[i, h] * scale  # causal prefix
+            logits = kn[cols, h] @ qn[i, h] * scale
             m = logits.max()
             p = np.exp(logits - m)
             l = p.sum()
-            o_ref = (p / l) @ vn[: i + 1, h % H]
+            o_ref = (p / l) @ vn[cols, h]
             lse_ref = m + np.log(l)
             np.testing.assert_allclose(
                 out[i, h], o_ref, atol=2e-4, rtol=2e-4,
@@ -155,3 +160,43 @@ def test_baseline_config3_cp8_262k_numeric(monkeypatch):
             )
     # planning at this scale must stay well under the 1M-token ~2s budget
     assert plan_s < 60, f"planning took {plan_s:.1f}s"
+
+
+@pytest.mark.slow
+def test_baseline_config3_cp8_262k_numeric(monkeypatch):
+    _run_sampled_pipeline(
+        monkeypatch, 0, [[0, S3]], [[0, S3]], [1], S3, CP3, 2048,
+        oracle_cols=lambda i: np.arange(i + 1),  # causal prefix
+    )
+
+
+S4 = 131072
+BLOCK4 = 512
+
+
+@pytest.mark.slow
+def test_baseline_config4_cp8_131k_video_numeric(monkeypatch):
+    """BASELINE config 4 — Magi-1 video block mask @ 131072, CP=8: the
+    full pipeline runs at scale with the sampled-row dense backend (the
+    config-3 recipe; the backend is mask-generic — it evaluates whatever
+    band slices the plan carries), checked per sampled row against a fp64
+    oracle over the video block mask."""
+    from magiattention_tpu.utils.sparse_utils import (
+        block_mask_to_ranges, make_video_block_mask,
+    )
+
+    frames = 16
+    bm = make_video_block_mask(frames, S4 // frames // BLOCK4, 2)
+    assert bm.shape[0] * BLOCK4 == S4
+    qr_v, kr_v, tm_v = block_mask_to_ranges(bm, BLOCK4, BLOCK4)
+    _run_sampled_pipeline(
+        monkeypatch, 4,
+        [[r.start, r.end] for r in qr_v],
+        [[r.start, r.end] for r in kr_v],
+        [t.to_int_type() for t in tm_v],
+        S4, CP3, 2048,
+        # (S4,) video-mask row -> attended global key columns
+        oracle_cols=lambda i: np.flatnonzero(
+            np.repeat(bm[i // BLOCK4], BLOCK4)
+        ),
+    )
