@@ -40,6 +40,18 @@ def ffa_block_k_dkv() -> int:
     return _get_int("MAGI_ATTENTION_FFA_BLOCK_K_DKV", 0)
 
 
+def ffa_blocks_pinned() -> bool:
+    """True when the operator pinned the fwd tile sizes via env — explicit
+    settings always beat MAGI_ATTENTION_FFA_AUTO_TILE (key ownership lives
+    HERE; callers must not hardcode these names)."""
+    import os
+
+    return (
+        "MAGI_ATTENTION_FFA_BLOCK_Q" in os.environ
+        or "MAGI_ATTENTION_FFA_BLOCK_K" in os.environ
+    )
+
+
 def ffa_max_slices() -> int:
     """Static upper bound on slice count per AttnArg (padding bucket)."""
     return _get_int("MAGI_ATTENTION_FFA_MAX_SLICES", 64)

@@ -36,6 +36,7 @@ from jax import shard_map
 from ..comm.primitives import cast_rows
 from ..env import comm as env_comm
 from ..env import general as env_general
+from ..env import kernel as env_kernel
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
@@ -248,9 +249,23 @@ class DistAttnRuntime:
         if self.use_overlap is None:
             self.use_overlap = self.num_stages > 1
 
-        bq, bk = default_blocks(
-            shard, kv_shard + total_recv, self.block_q, self.block_k
-        )
+        blk_q, blk_k = self.block_q, self.block_k
+        if blk_q is None and blk_k is None and not env_kernel.ffa_blocks_pinned():
+            from ..kernels.tile_policy import (
+                auto_tile_enabled, choose_blocks_multi,
+            )
+
+            if auto_tile_enabled():
+                # per-mask tile choice scored on the merged per-rank
+                # geometries (every rank runs the max-W padded grid)
+                blk_q, blk_k = choose_blocks_multi(
+                    [
+                        (a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
+                        for a in km.merged_args
+                    ],
+                    shard, kv_shard + total_recv,
+                )
+        bq, bk = default_blocks(shard, kv_shard + total_recv, blk_q, blk_k)
         self._bq, self._bk = bq, bk
 
         # merged (no-overlap) plan

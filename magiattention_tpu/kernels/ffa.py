@@ -1204,6 +1204,16 @@ def ffa_attn(
     sk, hk, dv = v.shape
     if softmax_scale is None:
         softmax_scale = float(d) ** -0.5
+    if block_q is None and block_k is None and not env_kernel.ffa_blocks_pinned():
+        from .tile_policy import auto_tile_enabled, choose_blocks
+
+        if auto_tile_enabled():
+            # plan-geometry-driven tile choice (ref tile tables analogue);
+            # explicit env/arg settings always take precedence
+            block_q, block_k = choose_blocks(
+                qr, kr, d_lo, d_hi, sq, sk, d, dv,
+                itemsize=q.dtype.itemsize,
+            )
     bq, bk = default_blocks(sq, sk, block_q, block_k)
 
     plan = get_ffa_plan(qr, kr, d_lo, d_hi, sq, sk, bq, bk)

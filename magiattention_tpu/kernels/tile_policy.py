@@ -1,0 +1,169 @@
+"""Automatic FFA tile-size selection (the TPU analogue of the reference's
+per-arch tile tables, ref magi_attention/functional/_flex_flash_attn_jit.py:41-57
+and csrc/flexible_flash_attention/tile_size.h).
+
+The reference hard-codes (head_dim, arch) -> tile tables tuned offline; on
+TPU the equivalent decision is (block_q, block_k), and the right choice
+depends on the *mask geometry*: wide dense masks amortize per-step
+bookkeeping best with big tiles, narrow bands waste padded MXU work unless
+tiles shrink. Because the host-side plan builder is cheap (native C path,
+LRU-cached), the policy can *measure* each candidate's true padded work for
+the actual slice set instead of guessing from mask type:
+
+    score(bq, bk) = W * bq * bk            # padded elements actually run
+                  + W * OVERHEAD_ELEMS     # per-grid-step fixed cost,
+                                           # expressed in element units
+
+``OVERHEAD_ELEMS`` is the one free constant (per-step softmax bookkeeping +
+pipeline bubble, in score-matrix-element equivalents). It is deliberately
+conservative pending silicon calibration from ``benchmarks/history``
+sweeps; at 0 the policy reduces to pure padded-area minimization.
+
+Selection is gated by ``MAGI_ATTENTION_FFA_AUTO_TILE=1`` and only applies
+when the caller didn't pin blocks (env or argument) — explicit settings
+always win, mirroring the reference's env-override contract.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..env.general import _get_int
+
+NUM_LANES = 128
+# per-grid-step fixed cost in score-element equivalents: ~the VPU work of
+# one (8, 128) bookkeeping pass per lane group. Refine from silicon sweeps
+# (benchmarks/history/true_rate.csv A/Bs) — see docs/performance.md.
+OVERHEAD_ELEMS = 8 * 1024
+# candidate tilings: bq multiples of 8 (fp32) / MXU-friendly, bk multiples
+# of 128 (lane tiling); spans the sweep grid the silicon harnesses measure
+CANDIDATES: tuple[tuple[int, int], ...] = (
+    (128, 512),
+    (256, 512),
+    (256, 1024),
+    (512, 512),
+    (512, 1024),
+    (1024, 512),
+    (1024, 1024),
+)
+# VMEM budget for one grid step's resident blocks (bytes), double-buffered;
+# ~16 MB/core on v5e minus headroom
+VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def auto_tile_enabled() -> bool:
+    return _get_int("MAGI_ATTENTION_FFA_AUTO_TILE", 0) == 1
+
+
+def count_ffa_work(
+    qr: np.ndarray,
+    kr: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+    sq: int,
+    sk: int,
+    bq: int,
+    bk: int,
+) -> int:
+    """Exact work-item count of :func:`ffa_plan.build_ffa_plan` for this
+    tiling WITHOUT building (or LRU-caching) the plan arrays — candidate
+    scoring must not evict live plans from the shared plan cache.
+
+    One work item per (slice, q_tile, k_tile) whose diagonal band
+    intersects the clipped tile rect (per q tile the intersecting k tiles
+    form one contiguous run, so that part is closed-form per (slice,
+    q_tile)) — plus the builder's one dummy item for every q tile whose
+    bucket stays empty (those tiles still need a grid step to write their
+    zeros/-inf outputs). Parity with the builder is pinned by test.
+    """
+    total = 0
+    num_q_tiles = max(1, -(-sq // bq))
+    num_k_tiles = max(1, -(-sk // bk))
+    covered = np.zeros(num_q_tiles, dtype=bool)
+    for s in range(len(qr)):
+        qs, qe = int(qr[s, 0]), int(qr[s, 1])
+        ks, ke = int(kr[s, 0]), int(kr[s, 1])
+        lo, hi = int(d_lo[s]), int(d_hi[s])
+        if qs >= qe or ks >= ke or lo > hi:
+            continue
+        t = np.arange(qs // bq, (qe - 1) // bq + 1, dtype=np.int64)
+        i0 = np.maximum(qs, t * bq)  # clipped row span per q tile
+        i1 = np.minimum(qe, (t + 1) * bq)
+        # attended column window of the clipped rows, clipped to [ks, ke)
+        j0 = np.maximum(ks, i0 + lo)
+        j1 = np.minimum(ke - 1, (i1 - 1) + hi)
+        nonempty = j0 <= j1  # empty window ⟺ band misses the clipped rect
+        kt0 = np.clip(j0 // bk, 0, num_k_tiles - 1)
+        kt1 = np.clip(j1 // bk, 0, num_k_tiles - 1)
+        total += int(np.sum((kt1 - kt0 + 1)[nonempty]))
+        covered[t[nonempty]] = True
+    return total + int(num_q_tiles - covered.sum())
+
+
+def _vmem_bytes(bq: int, bk: int, d: int, dv: int, itemsize: int) -> int:
+    """Rough per-step VMEM residency of the fwd kernel: q/k/v/out blocks
+    (double-buffered by the pipeline) + fp32 scratch (m, l, acc) + the
+    (bq, bk) fp32 score intermediate."""
+    blocks = (bq * d + bk * d + bk * dv + bq * dv) * itemsize * 2
+    scratch = (2 * bq * NUM_LANES + bq * dv) * 4
+    score = bq * bk * 4
+    return blocks + scratch + score
+
+
+def choose_blocks_multi(
+    rank_geoms: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+    sq: int,
+    sk: int,
+    d: int = 128,
+    dv: int = 128,
+    itemsize: int = 2,
+) -> tuple[int, int]:
+    """Pick (block_q, block_k) minimizing modeled kernel time over a group
+    of per-rank slice sets that share one padded grid (the CP runtime
+    stacks per-rank plans padded to the max work count, so every rank runs
+    max-W grid steps): score = max_rank(W) * (bq*bk + OVERHEAD_ELEMS),
+    VMEM-guarded. Falls back to the clamped default if every candidate is
+    excluded."""
+    seen: set[tuple[int, int]] = set()
+    best = None
+    best_score = None
+    for bq, bk in CANDIDATES:
+        # clamp to the problem (same rule as default_blocks), then dedupe
+        bq = min(bq, _round_up(sq, 16))
+        bk = min(bk, _round_up(sk, NUM_LANES))
+        if (bq, bk) in seen:
+            continue
+        seen.add((bq, bk))
+        if _vmem_bytes(bq, bk, d, dv, itemsize) > VMEM_BUDGET:
+            continue
+        w = max(
+            count_ffa_work(qr, kr, lo, hi, sq, sk, bq, bk)
+            for qr, kr, lo, hi in rank_geoms
+        )
+        score = w * (bq * bk + OVERHEAD_ELEMS)
+        if best_score is None or score < best_score:
+            best, best_score = (bq, bk), score
+    return best or (
+        min(256, _round_up(sq, 16)), min(512, _round_up(sk, NUM_LANES))
+    )
+
+
+def choose_blocks(
+    qr: np.ndarray,
+    kr: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+    sq: int,
+    sk: int,
+    d: int,
+    dv: int,
+    itemsize: int = 2,
+) -> tuple[int, int]:
+    """Single-slice-set entry of :func:`choose_blocks_multi`."""
+    return choose_blocks_multi(
+        [(qr, kr, d_lo, d_hi)], sq, sk, d, dv, itemsize
+    )
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
