@@ -1,0 +1,88 @@
+"""Fit the tile-policy overhead constant from recorded silicon sweeps.
+
+The auto-tile policy (kernels/tile_policy.py) scores a candidate tiling as
+``W * (bq*bk + OVERHEAD_ELEMS)``. This script fits OVERHEAD_ELEMS from the
+slope-timed per-tiling forward measurements in
+``benchmarks/history/true_rate.csv`` (probe names ``ffa_fwd_bq{bq}_bk{bk}``)
+via least squares on
+
+    ms(bq, bk)  ≈  alpha * W(bq,bk) * bq * bk  +  beta * W(bq,bk)
+
+so OVERHEAD_ELEMS = beta / alpha (score-element equivalents). Run after any
+chip window that recorded at least 3 distinct tilings; apply the result by
+updating tile_policy.OVERHEAD_ELEMS (with the fit stats in the commit).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from magiattention_tpu.kernels.mask_utils import types_to_bands  # noqa: E402
+from magiattention_tpu.kernels.tile_policy import count_ffa_work  # noqa: E402
+
+HIST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "history", "true_rate.csv",
+)
+# the bench shape every ffa_fwd_* probe in true_rate.py uses
+S, HQ = 4096, 16
+PAT = re.compile(r"^ffa_fwd_bq(\d+)_bk(\d+)$")
+
+
+def main() -> int:
+    if not os.path.exists(HIST):
+        print(f"no history at {HIST} — run a chip window first")
+        return 1
+    qr = np.array([[0, S]], np.int32)
+    kr = np.array([[0, S]], np.int32)
+    lo, hi = types_to_bands(qr, kr, np.array([1], np.int32))
+
+    # latest measurement per tiling wins (kernels improve across windows)
+    latest: dict[tuple[int, int], float] = {}
+    with open(HIST) as f:
+        for row in csv.DictReader(f):
+            m = PAT.match(row.get("probe", ""))
+            if m and row.get("ms"):
+                latest[(int(m.group(1)), int(m.group(2)))] = float(row["ms"])
+
+    if len(latest) < 3:
+        print(f"only {len(latest)} tilings recorded — need >= 3 to fit")
+        return 1
+
+    rows = []
+    for (bq, bk), ms in sorted(latest.items()):
+        w = count_ffa_work(qr, kr, lo, hi, S, S, bq, bk)
+        rows.append((bq, bk, w, ms))
+        print(f"bq={bq:5d} bk={bk:5d} W={w:5d} ms={ms:8.3f}")
+
+    a = np.array([[w * bq * bk, w] for bq, bk, w, _ in rows], float)
+    y = np.array([ms for *_, ms in rows], float)
+    (alpha, beta), res, *_ = np.linalg.lstsq(a, y, rcond=None)
+    if alpha <= 0:
+        print(f"degenerate fit (alpha={alpha:.3e}) — need more spread")
+        return 1
+    overhead = beta / alpha
+    pred = a @ np.array([alpha, beta])
+    err = np.abs(pred - y) / y
+    print(
+        f"\nalpha={alpha:.3e} ms/elem  beta={beta:.3e} ms/step"
+        f"  -> OVERHEAD_ELEMS ~= {overhead:,.0f}"
+        f"  (fit rel err max {err.max()*100:.1f}%)"
+    )
+    print(
+        "apply: set OVERHEAD_ELEMS in magiattention_tpu/kernels/"
+        "tile_policy.py (note the per-head grid: the constant is "
+        "head-count-independent because both terms scale with hq)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
