@@ -44,13 +44,29 @@ def main() -> int:
     kr = np.array([[0, S]], np.int32)
     lo, hi = types_to_bands(qr, kr, np.array([1], np.int32))
 
-    # latest measurement per tiling wins (kernels improve across windows)
-    latest: dict[tuple[int, int], float] = {}
+    # rows must come from ONE kernel commit: mixing windows would let a
+    # cross-commit speedup masquerade as a bq*bk/W effect and corrupt the
+    # fit. Use the commit with the most distinct tilings; newest wins ties
+    # (rows are appended chronologically).
+    by_commit: dict[str, dict[tuple[int, int], float]] = {}
+    order: list[str] = []
     with open(HIST) as f:
         for row in csv.DictReader(f):
             m = PAT.match(row.get("probe", ""))
             if m and row.get("ms"):
-                latest[(int(m.group(1)), int(m.group(2)))] = float(row["ms"])
+                c = row.get("commit", "?")
+                if c not in by_commit:
+                    by_commit[c] = {}
+                    order.append(c)
+                by_commit[c][(int(m.group(1)), int(m.group(2)))] = float(
+                    row["ms"]
+                )
+    if not by_commit:
+        print("no ffa_fwd tiling rows in history")
+        return 1
+    commit = max(reversed(order), key=lambda c: len(by_commit[c]))
+    latest = by_commit[commit]
+    print(f"fitting commit {commit} ({len(latest)} tilings)")
 
     if len(latest) < 3:
         print(f"only {len(latest)} tilings recorded — need >= 3 to fit")
@@ -65,8 +81,13 @@ def main() -> int:
     a = np.array([[w * bq * bk, w] for bq, bk, w, _ in rows], float)
     y = np.array([ms for *_, ms in rows], float)
     (alpha, beta), res, *_ = np.linalg.lstsq(a, y, rcond=None)
-    if alpha <= 0:
-        print(f"degenerate fit (alpha={alpha:.3e}) — need more spread")
+    if alpha <= 0 or beta < 0:
+        # beta<0 would recommend a negative OVERHEAD_ELEMS, inverting the
+        # policy (rewarding more grid steps) — refuse, don't recommend
+        print(
+            f"degenerate fit (alpha={alpha:.3e}, beta={beta:.3e}) — "
+            "need more tilings / less noise; no recommendation"
+        )
         return 1
     overhead = beta / alpha
     pred = a @ np.array([alpha, beta])

@@ -46,6 +46,15 @@ run_step() {  # run_step <timeout> <logfile> <cmd...>
   timeout "$t" "$@" > "$log" 2>&1
   local rc=$?  # capture before the $(...) substitutions below reset $?
   echo "[$(date -u +%H:%M:%S)] $(basename "$log" .log) rc=$rc" >> "$LOG"
+  # A step failure only aborts the queue when the CHIP is gone (the '||
+  # return' contract is window-drop detection): re-probe on failure so a
+  # script bug doesn't cost the remaining steps, but a dead tunnel —
+  # where every remaining step would hang to its timeout — skips cleanly.
+  if [ "$rc" -ne 0 ] && ! probe; then
+    echo "[$(date -u +%H:%M:%S)] chip gone after failing step" >> "$LOG"
+    return 1
+  fi
+  return 0
 }
 
 run_queue() {
@@ -78,6 +87,9 @@ run_queue() {
   # chip-static calibration (matmul ceiling, launch overhead, bundled-kernel
   # A/B) after the kernel-dependent steps: short windows must spend their
   # minutes on the measurements each round actually needs
+  # load-balance evidence: unpadded min/max-W rank timings + padding tax
+  # for BASELINE configs 3 (causal) and 4 (video) on the real CP=8 plans
+  run_step 1800 ".tpu_logs/${TS}_balance.log" python -u scripts/tpu_rank_balance.py || return
   run_step 1200 ".tpu_logs/${TS}_calibrate.log" python -u scripts/tpu_calibrate.py || return
   run_step 1200 ".tpu_logs/${TS}_profile.log" python -u scripts/tpu_profile_ffa.py .tpu_logs/ffa_trace
   # unproven-on-silicon step last so its failure can't cost the trace
