@@ -92,7 +92,15 @@ def main() -> int:
     ap.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"])
     ap.add_argument("--backward", action="store_true")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument(
+        "--auto-tile", action="store_true",
+        help="run with MAGI_ATTENTION_FFA_AUTO_TILE=1 (per-mask tile "
+        "policy) — rows are tagged tiling=auto for the A/B vs env defaults",
+    )
     args = ap.parse_args()
+
+    if args.auto_tile:
+        os.environ["MAGI_ATTENTION_FFA_AUTO_TILE"] = "1"
 
     import jax
 
@@ -174,6 +182,7 @@ def main() -> int:
                 if jax.default_backend() == "tpu":
                     append_row("kernel_grid", {
                         "mask": name, "seqlen": s, "dtype": args.dtype,
+                        "tiling": "auto" if args.auto_tile else "env",
                         **{kk: vv for kk, vv in row.items()
                            if kk not in ("mask", "seqlen")},
                     })

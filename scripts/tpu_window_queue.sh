@@ -84,6 +84,10 @@ run_queue() {
   # BASELINE config 4: the Magi-1 video block mask at its full 131k seqlen
   run_step 1800 ".tpu_logs/${TS}_video131k.log" python -u benchmarks/kernel_bench.py \
     --seqlens 131072 --masks video --backward || return
+  # auto-tile A/B: same grid rows with the per-mask tile policy on
+  # (tiling=auto vs tiling=env in kernel_grid.csv)
+  run_step 1500 ".tpu_logs/${TS}_grid_autotile.log" python -u benchmarks/kernel_bench.py \
+    --seqlens 8192 --backward --auto-tile || return
   # chip-static calibration (matmul ceiling, launch overhead, bundled-kernel
   # A/B) after the kernel-dependent steps: short windows must spend their
   # minutes on the measurements each round actually needs
