@@ -39,6 +39,12 @@ def __getattr__(name):
         "undispatch",
         "calc_attn",
         "get_position_ids",
+        "get_mesh",
+        "roll",
+        "roll_simple",
+        "magi_attn_flex_dispatch",
+        "magi_attn_varlen_dispatch",
+        "flex_flash_attn_func",
         # reference top-level names (ref __init__.py:86-97)
         "init_dist_attn_runtime_key",
         "init_dist_attn_runtime_mgr",

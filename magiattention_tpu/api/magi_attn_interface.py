@@ -321,6 +321,52 @@ def roll(
     return _mgr(key).roll(x, shifts)
 
 
+def roll_simple(
+    x: jax.Array, key: DistAttnRuntimeKey, shifts: int = 1
+) -> jax.Array:
+    """Alias of :func:`roll` under the reference's ``roll_simple`` name
+    (the batched-P2P vs isend/irecv distinction is a CUDA stream concern;
+    on TPU both lower to the same segment-ppermute program). NOTE the
+    TPU-native argument order ``(x, key, shifts)`` — the reference takes
+    ``(x, shift, dim, key)``; see docs/migration.md."""
+    return roll(x, key, shifts)
+
+
+def magi_attn_flex_dispatch(
+    x: jax.Array,
+    q_ranges,
+    k_ranges,
+    attn_mask_type,
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+    **key_kwargs,
+) -> tuple[jax.Array, DistAttnRuntimeKey]:
+    """Key + dispatch in one call: returns ``(local_x, key)`` (the ref
+    :730 combo under its name — NOT signature-identical: mesh/cp_axis/
+    chunk_size arrive as keywords and the torch-only num_heads/head_dim/
+    pad_size/cp_group params don't exist here; see docs/migration.md. New
+    code should call :func:`magi_attn_flex_key` then :func:`dispatch`)."""
+    key = magi_attn_flex_key(
+        q_ranges, k_ranges, attn_mask_type,
+        total_seqlen_q, total_seqlen_k, **key_kwargs,
+    )
+    return dispatch(x, key), key
+
+
+def magi_attn_varlen_dispatch(
+    x: jax.Array,
+    cu_seqlens_q,
+    cu_seqlens_k=None,
+    **key_kwargs,
+) -> tuple[jax.Array, DistAttnRuntimeKey]:
+    """Key + dispatch for cu_seqlens masks: returns ``(local_x, key)``
+    (the ref api :307 combo under its name — keyword-style args as in
+    :func:`magi_attn_varlen_key`, not the torch signature; see
+    docs/migration.md)."""
+    key = magi_attn_varlen_key(cu_seqlens_q, cu_seqlens_k, **key_kwargs)
+    return dispatch(x, key), key
+
+
 def get_position_ids(key: DistAttnRuntimeKey) -> jax.Array:
     """Global position of each dispatched row (for RoPE etc., ref :1117)."""
     return _mgr(key).get_position_ids()

@@ -1,0 +1,126 @@
+"""API-surface parity with the reference's ``magi_attention.api.__all__``.
+
+Every name the reference exports (torch/CUDA-specific entries excluded with
+a recorded reason) must exist on ``magiattention_tpu.api``; the migration
+combos must behave as key+dispatch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import magiattention_tpu.api as api
+
+# ref magi_attention/api/__init__.py __all__ — name: why-absent (None = must exist)
+REF_ALL = {
+    "magi_attn_varlen_key": None,
+    "magi_attn_varlen_dispatch": None,
+    "magi_attn_flex_key": None,
+    "magi_attn_flex_dispatch": None,
+    "dispatch": None,
+    "undispatch": None,
+    "roll": None,
+    "roll_simple": None,
+    "calc_attn": None,
+    "clear_cache": None,
+    "get_most_recent_key": None,
+    "get_position_ids": None,
+    "make_varlen_key_for_new_mask_after_dispatch": None,
+    "make_flex_key_for_new_mask_after_dispatch": None,
+    "flex_flash_attn_func": None,
+    "compute_pad_size": None,
+    "squash_batch_dim": None,
+    "infer_varlen_mask_from_batch": None,
+    "infer_attn_mask_from_sliding_window": None,
+    "infer_attn_mask_from_cu_seqlens": None,
+    "AttnForwardMeta": None,
+    "AttnMaskType": None,
+    "AttnOverlapMode": None,
+    "AttnRanges": None,
+    "DistAttnRuntimeKey": None,
+    "GeneralAttnMaskType": "torch-typing alias (str|AttnMaskType union); "
+    "our signatures accept the same mixed forms directly",
+    "DistAttnConfig": None,
+    "DispatchConfig": None,
+    "OverlapConfig": None,
+    "GrpCollConfig": None,
+    # dispatch/overlap algorithm CLASSES: the TPU build selects algorithms
+    # by enum (DispatchConfig(alg=DispatchAlgType.*) /
+    # OverlapConfig(alg=OverlapAlgType.*), common/enum.py) instead of
+    # passing class instances — same selection surface, different idiom
+    "DispatchAlg": "selected via DispatchAlgType enum",
+    "MinHeapDispatchAlg": "selected via DispatchAlgType.MINHEAP",
+    "ToppHeapDispatchAlg": "selected via DispatchAlgType.TOPP_HEAP",
+    "SequentialDispatchAlg": "selected via DispatchAlgType.SEQUENTIAL",
+    "SortedSequentialSelectAlg": "selected via "
+    "DispatchAlgType.SORTED_SEQUENTIAL_SELECT",
+    "LBDispatchAlg": "selected via DispatchAlgType.LOWER_BOUND",
+    "DPDispatchAlg": "selected via DispatchAlgType.DP",
+    "BSDispatchAlg": "selected via DispatchAlgType.BINARY_SEARCH",
+    "OverlapAlg": "selected via OverlapAlgType enum",
+    "UniformOverlapAlg": "selected via OverlapAlgType.UNIFORM",
+    "GreedyOverlapAlg": "selected via OverlapAlgType.GREEDY",
+    "DistAttnRuntimeDictManager": "per-pg LRU is internal "
+    "(api.magi_attn_interface._runtime_dict); cache control via "
+    "clear_cache/get_most_recent_key",
+    "dist_attn_runtime_dict_mgr": "see DistAttnRuntimeDictManager",
+}
+
+
+def test_ref_all_names_accounted_for():
+    """REF_ALL must cover the reference's __all__ exactly — no silent
+    omissions (every excluded name carries a recorded reason)."""
+    import re
+
+    src = open("/root/reference/magi_attention/api/__init__.py").read()
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    assert m, "reference __all__ not found"
+    ref_names = set(re.findall(r'"([^"]+)"', m.group(1)))
+    assert ref_names == set(REF_ALL), (
+        sorted(ref_names - set(REF_ALL)), sorted(set(REF_ALL) - ref_names)
+    )
+
+
+def test_reference_api_surface_present():
+    missing = [
+        n for n, why in REF_ALL.items()
+        if why is None and not hasattr(api, n)
+    ]
+    assert not missing, missing
+
+
+def test_flex_dispatch_combo_equals_key_plus_dispatch():
+    s = 128
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), axis_names=("cp",))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((s, 8)), jnp.float32)
+
+    local_x, key = api.magi_attn_flex_dispatch(
+        x, [[0, s]], [[0, s]], [1], s, s, mesh=mesh, chunk_size=16,
+    )
+    key2 = api.magi_attn_flex_key(
+        [[0, s]], [[0, s]], [1], s, s, mesh=mesh, chunk_size=16,
+    )
+    assert key == key2
+    np.testing.assert_array_equal(
+        np.asarray(local_x), np.asarray(api.dispatch(x, key2))
+    )
+    # round trip
+    np.testing.assert_allclose(
+        np.asarray(api.undispatch(local_x, key)), np.asarray(x)
+    )
+
+
+def test_varlen_dispatch_combo_and_roll_simple():
+    s = 128
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), axis_names=("cp",))
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((s, 4)), jnp.float32)
+    local_x, key = api.magi_attn_varlen_dispatch(
+        x, [0, s // 2, s], causal=True, mesh=mesh, chunk_size=16,
+    )
+    rolled = api.roll_simple(local_x, key, shifts=1)
+    expect = np.asarray(api.roll(local_x, key, shifts=1))
+    np.testing.assert_array_equal(np.asarray(rolled), expect)
