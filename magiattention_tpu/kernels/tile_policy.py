@@ -101,13 +101,11 @@ def count_ffa_work(
 
 
 def _vmem_bytes(bq: int, bk: int, d: int, dv: int, itemsize: int) -> int:
-    """Rough per-step VMEM residency of the fwd kernel: q/k/v/out blocks
-    (double-buffered by the pipeline) + fp32 scratch (m, l, acc) + the
-    (bq, bk) fp32 score intermediate."""
-    blocks = (bq * d + bk * d + bk * dv + bq * dv) * itemsize * 2
-    scratch = (2 * bq * NUM_LANES + bq * dv) * 4
-    score = bq * bk * 4
-    return blocks + scratch + score
+    """Per-step fwd-kernel VMEM residency — ONE estimator for the whole
+    package (utils/mem_budget.ffa_vmem_budget)."""
+    from ..utils.mem_budget import ffa_vmem_budget
+
+    return ffa_vmem_budget(bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize)
 
 
 def choose_blocks_multi(
