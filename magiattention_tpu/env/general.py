@@ -93,6 +93,7 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     "MAGI_ATTENTION_FFA_BLOCK_Q_DKV",
     "MAGI_ATTENTION_FFA_BLOCK_K_DKV",
     "MAGI_ATTENTION_FFA_GQA_PACK",
+    "MAGI_ATTENTION_FFA_GQA_PACK_DQ",
     "MAGI_ATTENTION_FFA_AUTO_TILE",
     # wire-tier selection changes the traced collective program
     "MAGI_ATTENTION_RAGGED_GRPCOLL",

@@ -66,6 +66,14 @@ def ffa_native_plan() -> str:
     return _get_str("MAGI_ATTENTION_NATIVE_FFA_PLAN", "auto").lower()
 
 
+def ffa_gqa_pack_dq() -> bool:
+    """GQA-pack the dq backward kernel (grid (hk, W)): k/v fetched once
+    per work item instead of per q-head, s/dp matmuls g x taller,
+    lse/delta tile-packed on the host. Opt-in until silicon A/B data picks
+    a default; VMEM-guarded like the fwd pack."""
+    return _get_int("MAGI_ATTENTION_FFA_GQA_PACK_DQ", 0) == 1
+
+
 def ffa_gqa_pack() -> bool:
     """Pack the whole GQA query group of one kv head into each fwd grid
     step (grid (hk, W) instead of (hq, W)): k/v HBM traffic drops by the

@@ -41,7 +41,7 @@ from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
     _ffa_bwd_dkv_pallas,
-    _ffa_bwd_dq_pallas,
+    ffa_bwd_dq_pallas_dispatch,
     ffa_fwd_pallas_dispatch,
     _should_interpret,
     ffa_attn_with_plan,
@@ -120,7 +120,7 @@ def _multi_ffa_bwd(params_list, res, cts):
         ).T
         delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
         dq_arrs, dkv_arrs = _bwd_plan_slices(arrs)
-        dq_t = _ffa_bwd_dq_pallas(
+        dq_t = ffa_bwd_dq_pallas_dispatch(
             prm, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
         )
         dk_t, dv_t = _ffa_bwd_dkv_pallas(

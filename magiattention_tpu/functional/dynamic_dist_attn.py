@@ -37,7 +37,7 @@ from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
     _ffa_bwd_dkv_pallas,
-    _ffa_bwd_dq_pallas,
+    ffa_bwd_dq_pallas_dispatch,
     _should_interpret,
     default_blocks,
     ffa_attn_with_plan,
@@ -141,7 +141,7 @@ def _dyn_bwd(static, axis, res, cts):
     delta_t = jnp.pad(delta_buf, ((0, sqp - nbuf), (0, 0))).T
 
     dq_arrs, dkv_arrs = _bwd_plan_slices(arrays)
-    dq_t = _ffa_bwd_dq_pallas(
+    dq_t = ffa_bwd_dq_pallas_dispatch(
         params, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     dk_t, dv_t = _ffa_bwd_dkv_pallas(

@@ -227,7 +227,7 @@ def _ffa_sink_core_bwd(params, res, cts):
     from ..kernels.ffa import (
         _bwd_plan_slices,
         _ffa_bwd_dkv_pallas,
-        _ffa_bwd_dq_pallas,
+        ffa_bwd_dq_pallas_dispatch,
     )
     from .dist_attn import _head_major
     from .sink import sink_bwd
@@ -247,7 +247,7 @@ def _ffa_sink_core_bwd(params, res, cts):
     ).T
     delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
     dq_arrs, dkv_arrs = _bwd_plan_slices(arrays)
-    dq_t = _ffa_bwd_dq_pallas(
+    dq_t = ffa_bwd_dq_pallas_dispatch(
         params, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     dk_t, dv_t = _ffa_bwd_dkv_pallas(

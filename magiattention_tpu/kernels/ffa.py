@@ -749,6 +749,206 @@ def _ffa_bwd_dq_pallas(
     return dq_t * params.softmax_scale
 
 
+def _bwd_dq_kernel_gqa(
+    work_qt_ref,
+    work_kt_ref,
+    meta_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    softcap: float,
+    bq: int,
+    bk: int,
+    g: int,
+):
+    """GQA-packed dq: grid (hk, W) — the whole query group of one kv head
+    per grid step (vs :func:`_bwd_dq_kernel`'s (hq, W)). k/v are fetched
+    ONCE per work item instead of ``g`` times and the per-step s/dp matmuls
+    run ``g``x taller. lse/delta arrive TILE-PACKED from the host:
+    ``(hk, num_q_tiles, g*bq)`` with packed row ``gi*bq + r`` = head
+    ``h*g+gi``, row ``qt*bq + r`` — so the kernel's column view is the same
+    lanes->sublanes expand the unpacked kernel uses, just ``g``x taller.
+    """
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    is_full = meta_ref[w, IS_FULL]
+    use_exp2 = softcap == 0.0
+    exp_fn = jnp.exp2 if use_exp2 else jnp.exp
+
+    @pl.when(is_first == 1)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    d = q_ref.shape[-1]
+    q = q_ref[0].reshape(g * bq, d)  # pre-scaled on host
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if softcap > 0.0:
+        sc = softcap * jnp.tanh(s / softcap)
+        dcap = 1.0 - (sc / softcap) ** 2
+    else:
+        sc = s
+        dcap = None
+
+    lse = jnp.expand_dims(lse_ref[0], -1)  # (g*bq, 1), tile-packed rows
+    delta = jnp.expand_dims(delta_ref[0], -1)
+    dv = v_ref.shape[-1]
+    dp = jax.lax.dot_general(
+        do_ref[0].reshape(g * bq, dv), v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    def accum(sm, masked: bool):
+        if masked:
+            neg = lse <= EMPTY_THRESH
+            lse_safe = jnp.where(neg, 0.0, lse)
+            if use_exp2:
+                lse_safe = lse_safe * LOG2E
+            p = exp_fn(sm - lse_safe)
+            p = jnp.where(neg, 0.0, p)
+        else:
+            p = exp_fn(sm - (lse * LOG2E if use_exp2 else lse))
+        ds = p * (dp - delta)
+        if dcap is not None:
+            ds = ds * dcap
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(is_full == 1)
+    def _():
+        accum(sc, masked=False)
+
+    @pl.when(is_full == 0)
+    def _():
+        q_base = work_qt_ref[w] * bq
+        k_base = work_kt_ref[w] * bk
+        accum(
+            jnp.where(
+                _item_mask(meta_ref, w, q_base, k_base, bq, bk, repeat=g),
+                sc, MASK_VALUE,
+            ),
+            masked=True,
+        )
+
+    @pl.when(is_last == 1)
+    def _():
+        dq_ref[0] = dq_scr[:].reshape(g, bq, d)
+
+
+def _tile_pack_rows(x_t: jax.Array, hk: int, g: int, bq: int) -> jax.Array:
+    """(hq, sqp) fp32 -> (hk, num_q_tiles, 1, g*bq) tile-packed rows for
+    the packed dq kernel (host-side; one transpose of a small fp32 array).
+    The unit sublane axis keeps the BlockSpec's trailing-two dims equal to
+    the array dims (the Pallas TPU (8, 128) divisibility rule)."""
+    hq, sqp = x_t.shape
+    nqt = sqp // bq
+    return (
+        x_t.reshape(hk, g, nqt, bq).transpose(0, 2, 1, 3).reshape(
+            hk, nqt, 1, g * bq
+        )
+    )
+
+
+def _ffa_bwd_dq_pallas_gqa(
+    params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t,
+    delta_t,
+):
+    """GQA-packed dq pallas call (see :func:`_bwd_dq_kernel_gqa`)."""
+    bq, bk = params.dq_blocks()
+    hq, sqp, d = q_t.shape
+    hk, skp, dv = v_t.shape
+    g = params.group
+    W = params.num_work_dq if params.num_work_dq is not None else params.num_work
+
+    use_exp2 = params.softcap == 0.0
+    q_scale = params.softmax_scale * (LOG2E if use_exp2 else 1.0)
+    q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
+    q_g = q_t.reshape(hk, g, sqp, d)
+    do_g = do_t.reshape(hk, g, sqp, dv)
+    lse_p = _tile_pack_rows(_clamp_lse(lse_t), hk, g, bq)
+    delta_p = _tile_pack_rows(delta_t, hk, g, bq)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(hk, W),
+        in_specs=[
+            pl.BlockSpec((1, g, bq, d), lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, g, bq, dv),
+                         lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, None, 1, g * bq),
+                         lambda h, w, qt, kt, mt: (h, qt[w], 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, None, 1, g * bq),
+                         lambda h, w, qt, kt, mt: (h, qt[w], 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, g, bq, d),
+                         lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[pltpu.VMEM((g * bq, d), jnp.float32)],
+    )
+    kernel = partial(
+        _bwd_dq_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g,
+    )
+    (dq_g,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((hk, g, sqp, d), jnp.float32)],
+        interpret=params.interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(work_qt, work_kt, meta, q_g, k_t, v_t, do_g, lse_p, delta_p)
+    return dq_g.reshape(hq, sqp, d) * params.softmax_scale
+
+
+def _use_gqa_pack_dq(params: FFAParams) -> bool:
+    """Trace-time dispatch to the packed dq kernel: opt-in flag, real
+    grouping, VMEM guard on the packed (g*bq, bk) fp32 score tile +
+    (g*bq, d) fp32 scratch."""
+    bq, bk = params.dq_blocks()
+    return (
+        env_kernel.ffa_gqa_pack_dq()
+        and params.group > 1
+        and params.group * bq * (bk + 256) * 4 <= 8 * 1024 * 1024
+    )
+
+
+def ffa_bwd_dq_pallas_dispatch(
+    params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t,
+    delta_t,
+):
+    """dq backward with the GQA-packing dispatch applied — the ONE entry
+    every backward path (custom-vjp core, CP multi-stage, sink, dynamic)
+    uses so the packed dq kernel is reachable from all of them (mirrors
+    :func:`ffa_fwd_pallas_dispatch`)."""
+    fn = (
+        _ffa_bwd_dq_pallas_gqa if _use_gqa_pack_dq(params)
+        else _ffa_bwd_dq_pallas
+    )
+    return fn(params, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t,
+              delta_t)
+
+
 # ---------------------------------------------------------------------------
 # backward: dk/dv (k-major plan)
 # ---------------------------------------------------------------------------
@@ -1004,7 +1204,7 @@ def _ffa_core_bwd(params: FFAParams, res, cts):
     delta_t = jnp.sum(
         do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
     )  # (hq, sqp)
-    dq_t = _ffa_bwd_dq_pallas(
+    dq_t = ffa_bwd_dq_pallas_dispatch(
         params, *dq_arrays, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     dk_t, dv_t = _ffa_bwd_dkv_pallas(

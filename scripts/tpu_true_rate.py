@@ -192,8 +192,9 @@ def main():
     for bq, bk in [(256, 512), (512, 1024), (1024, 1024)]:
         run_ffa_tiling(bq, bk)
 
-    # GQA-packed fwd A/B (MAGI_ATTENTION_FFA_GQA_PACK): packed grid
-    # (hk, W) — k/v HBM traffic /g. Env read at trace time, so set it
+    # GQA-packed A/Bs: fwd pack (MAGI_ATTENTION_FFA_GQA_PACK, grid (hk, W)
+    # — k/v HBM traffic /g) and dq pack (MAGI_ATTENTION_FFA_GQA_PACK_DQ,
+    # same idea for the dq backward). Env read at trace time, so set it
     # around body construction only.
     prev_pack = os.environ.get("MAGI_ATTENTION_FFA_GQA_PACK")
     os.environ["MAGI_ATTENTION_FFA_GQA_PACK"] = "1"
@@ -217,6 +218,29 @@ def main():
             os.environ.pop("MAGI_ATTENTION_FFA_GQA_PACK", None)
         else:
             os.environ["MAGI_ATTENTION_FFA_GQA_PACK"] = prev_pack
+
+    prev_pack_dq = os.environ.get("MAGI_ATTENTION_FFA_GQA_PACK_DQ")
+    os.environ["MAGI_ATTENTION_FFA_GQA_PACK_DQ"] = "1"
+    try:
+        def ffa_loss_pdq(q, k, v):
+            o, _ = ffa_attn(q, k, v, qr, kr, tm, block_q=512, block_k=512)
+            return jnp.sum(o.astype(jnp.float32) * ws.astype(jnp.float32))
+
+        try:
+            g = jax.grad(ffa_loss_pdq, argnums=(0, 1, 2))
+            step = make_consume_all_grads_body(
+                lambda q: g(q, ks, vs), jnp.bfloat16
+            )
+            msb = do_bench_scan_slope(step, qs, lengths=LENGTHS, verbose=True)
+            record("ffa_fwdbwd_gqapackdq_bq512_bk512", msb, fwd_flops * 3.5)
+        except Exception as e:
+            print(f"gqapack_dq: FAIL {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+    finally:
+        if prev_pack_dq is None:
+            os.environ.pop("MAGI_ATTENTION_FFA_GQA_PACK_DQ", None)
+        else:
+            os.environ["MAGI_ATTENTION_FFA_GQA_PACK_DQ"] = prev_pack_dq
 
     mm_probe(8192)
 

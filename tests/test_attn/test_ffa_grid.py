@@ -233,6 +233,74 @@ def test_gqa_packed_matches_unpacked(monkeypatch, seed, g):
         )
 
 
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("g", [2, 4])
+def test_gqa_packed_dq_matches_unpacked(monkeypatch, seed, g):
+    """MAGI_ATTENTION_FFA_GQA_PACK_DQ parity: the packed dq kernel must be
+    BIT-IDENTICAL to the unpacked one (same per-row math and accumulation
+    order — only the grid layout and the host-side lse/delta tile packing
+    differ) on random band slices; dk/dv are untouched by the flag."""
+    rng = np.random.default_rng(300 + seed)
+    sq = sk = 320
+    hk, d = 2, 64
+    hq = hk * g
+    qr, kr, lo, hi = _random_band_meta(rng, sq, sk, 4)
+    q = jnp.asarray(rng.standard_normal((sq, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((sk, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((sk, hk, d)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((sq, hq, d)), jnp.float32)
+
+    def run():
+        def loss(q_, k_, v_):
+            o, _ = ffa_attn(q_, k_, v_, qr, kr, d_lo=lo, d_hi=hi,
+                            block_q=64, block_k=128)
+            return jnp.sum(o * w)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.delenv("MAGI_ATTENTION_FFA_GQA_PACK_DQ", raising=False)
+    g_u = run()
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_GQA_PACK_DQ", "1")
+    g_p = run()
+    for name, a, b in zip("dq dk dv".split(), g_u, g_p):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=name
+        )
+
+
+def test_gqa_packed_dq_softcap_and_bwd_overrides(monkeypatch):
+    """Packed dq with softcap, dv != dk and dq-specific tile overrides —
+    grads vs the dense fp32 oracle."""
+    rng = np.random.default_rng(11)
+    sq = sk = 256
+    hq, hk, d, dv = 4, 2, 64, 128
+    qr = np.array([[0, sq]], np.int32)
+    kr = np.array([[0, sk]], np.int32)
+    tm = np.array([1], np.int32)
+    q = jnp.asarray(rng.standard_normal((sq, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((sk, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((sk, hk, dv)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((sq, hq, dv)), jnp.float32)
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_GQA_PACK_DQ", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_BLOCK_Q_DQ", "64")
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_BLOCK_K_DQ", "256")
+
+    def loss_k(q_, k_, v_):
+        o, _ = ffa_attn(q_, k_, v_, qr, kr, tm, softcap=20.0,
+                        block_q=128, block_k=128)
+        return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+
+    def loss_r(q_, k_, v_):
+        o, _ = sdpa_attn(q_, k_, v_, qr, kr, tm, softcap=20.0,
+                         compute_dtype=jnp.float32)
+        return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+
+    g_k = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), g_k, g_r):
+        assert_close(a, b, atol=2e-5, rtol=2e-5, norm_rtol=2e-6)
+
+
 def test_gqa_packed_softcap_and_dv(monkeypatch):
     """Packed path with softcap and dv != dk against the dense oracle."""
     rng = np.random.default_rng(7)

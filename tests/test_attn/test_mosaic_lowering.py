@@ -199,3 +199,25 @@ def test_gqa_packed_bwd_lowers(mosaic, monkeypatch):
 
     text = _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
     assert text.count("tpu_custom_call") >= 3
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_gqa_packed_dq_lowers(mosaic, monkeypatch, dtype):
+    """MAGI_ATTENTION_FFA_GQA_PACK_DQ=1: the packed (hk, W)-grid dq kernel
+    (rank-4 q/do blocks, tile-packed lse/delta rows) must lower to Mosaic
+    — with and without dq-specific tile overrides."""
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_GQA_PACK_DQ", "1")
+    s, hq, hk, d = 2048, 4, 2, 128
+    q, k, v = _mk_inputs(s, hq, hk, d, d, dtype)
+    qr, kr, tm = _varlen_meta(s)
+
+    def loss(q, k, v):
+        o, _ = ffa.ffa_attn(q, k, v, qr, kr, tm, block_q=512, block_k=512)
+        return jnp.sum(o.astype(jnp.float32))
+
+    text = _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+    assert text.count("tpu_custom_call") >= 3
+
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_BLOCK_Q_DQ", "256")
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_BLOCK_K_DQ", "1024")
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
