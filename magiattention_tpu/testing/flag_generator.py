@@ -22,6 +22,9 @@ FLAG_SPACE: dict[str, list[str | None]] = {
     "MAGI_ATTENTION_CPP_BACKEND": [None, "0", "1"],
     "MAGI_ATTENTION_DETERMINISTIC_MODE": [None, "0", "1"],
     "MAGI_ATTENTION_NATIVE_FFA_PLAN": [None, "0", "1"],
+    "MAGI_ATTENTION_FFA_GQA_PACK": [None, "0", "1"],
+    "MAGI_ATTENTION_FFA_GQA_PACK_DQ": [None, "0", "1"],
+    "MAGI_ATTENTION_FFA_AUTO_TILE": [None, "0", "1"],
 }
 
 HEURISTIC_COMBOS: list[dict[str, str]] = [
@@ -33,6 +36,11 @@ HEURISTIC_COMBOS: list[dict[str, str]] = [
      "MAGI_ATTENTION_DETERMINISTIC_MODE": "1"},
     {"MAGI_ATTENTION_KERNEL_BACKEND": "ffa",
      "MAGI_ATTENTION_NATIVE_FFA_PLAN": "0"},
+    # both GQA packs + auto-tile through the full pipeline at once
+    {"MAGI_ATTENTION_KERNEL_BACKEND": "ffa",
+     "MAGI_ATTENTION_FFA_GQA_PACK": "1",
+     "MAGI_ATTENTION_FFA_GQA_PACK_DQ": "1",
+     "MAGI_ATTENTION_FFA_AUTO_TILE": "1"},
 ]
 
 
