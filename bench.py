@@ -143,9 +143,45 @@ def run_worker() -> int:
             except Exception as se:  # record and try the next candidate
                 sweep_error = f"{bq2}x{bk2}: {type(se).__name__}"
                 continue
+        # GQA-pack variant at the winning tiling: bit-identical outputs
+        # (pinned by tests), so a faster pack legitimately takes the
+        # headline. Env flags are read at trace time — set around body
+        # construction only.
+        if not env_pinned and time.perf_counter() - _T_PROC_START < 300:
+            packs = {
+                "MAGI_ATTENTION_FFA_GQA_PACK": "1",
+                "MAGI_ATTENTION_FFA_GQA_PACK_DQ": "1",
+            }
+            saved = {kk: os.environ.get(kk) for kk in packs}
+            try:
+                os.environ.update(packs)
+                pk_ms = do_bench_scan_slope(
+                    make_body(block_q, block_k), q, reps=2
+                )
+                sweep_points.append({
+                    "block_q": block_q, "block_k": block_k,
+                    "gqa_packs": 1, "tflops": tf(pk_ms),
+                })
+                if pk_ms < dt_ms:
+                    dt_ms = pk_ms
+                    result_packs = True
+                else:
+                    result_packs = False
+            except Exception as se:
+                sweep_error = f"packs: {type(se).__name__}"
+                result_packs = False
+            finally:
+                for kk, vv in saved.items():
+                    if vv is None:
+                        os.environ.pop(kk, None)
+                    else:
+                        os.environ[kk] = vv
+        else:
+            result_packs = False
     except Exception as e:
         # fallback: chained dispatches (serial data dependence). Record why so
         # a real compile failure in the scan path is visible in the output.
+        result_packs = False
         timing_mode = f"chained ({type(e).__name__})"
         step = jax.jit(make_body(block_q, block_k))
         qq = step(q)
@@ -202,6 +238,7 @@ def run_worker() -> int:
         "mfu_hw": round(mfu * hw_ratio, 4),
         "block_q": block_q,
         "block_k": block_k,
+        "gqa_packs": bool(result_packs),
     }
     if chip_matmul_tf:
         result["chip_matmul_tflops"] = chip_matmul_tf
