@@ -1,0 +1,96 @@
+"""HuggingFace -> magiattention_tpu weight conversion.
+
+The reference integrates with HF Transformers as the CP backend inside a
+torch model (ref examples/transformers/); the TPU build's models are
+JAX-native, so migration needs a weight bridge instead: this module maps a
+HF ``LlamaForCausalLM`` state dict onto :mod:`models.llama`'s params pytree
+(numerically exact — pinned by a logits-parity test against the torch
+forward).
+
+Layout notes (HF stores ``nn.Linear`` weight as (out, in); ours are
+(in, out) matmul-ready, so every projection transposes):
+
+- ``model.embed_tokens.weight (vocab, dim)`` -> ``embed`` (as-is)
+- ``layers.N.self_attn.{q,k,v,o}_proj.weight`` -> ``wq/wk/wv/wo`` (T)
+- ``layers.N.input_layernorm.weight`` -> ``attn_norm``
+- ``layers.N.post_attention_layernorm.weight`` -> ``mlp_norm``
+- ``layers.N.mlp.{gate,up,down}_proj.weight`` -> ``w_gate/w_up/w_down`` (T)
+- ``model.norm.weight`` -> ``final_norm``; ``lm_head.weight`` -> ``lm_head`` (T)
+
+The rotary convention matches (both rotate first-half/second-half pairs),
+so no permutation of q/k rows is needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+
+
+def config_from_hf(hf_config, dtype: str = "bfloat16") -> LlamaConfig:
+    """LlamaConfig from a HF ``LlamaConfig``. ``dtype`` is the activation
+    compute dtype (bf16 for training-speed parity on TPU; pass "float32"
+    for bitwise-close parity checks against a fp32 torch forward)."""
+    head_dim = getattr(hf_config, "head_dim", None) or (
+        hf_config.hidden_size // hf_config.num_attention_heads
+    )
+    return LlamaConfig(
+        dtype=dtype,
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        head_dim=head_dim,
+        ffn_hidden=hf_config.intermediate_size,
+        rope_theta=float(hf_config.rope_theta),
+        norm_eps=float(hf_config.rms_norm_eps),
+    )
+
+
+def _t(sd, key):
+    w = sd[key]
+    return jnp.asarray(np.asarray(w, dtype=np.float32))
+
+
+def params_from_hf_state_dict(sd: dict, cfg: LlamaConfig) -> dict:
+    """HF LlamaForCausalLM state dict (tensors or arrays) -> params pytree.
+
+    Accepts torch tensors (call ``.detach().cpu()`` upstream or pass
+    ``{k: v.numpy() for ...}``) or numpy arrays. ``lm_head.weight`` falls
+    back to the embedding (tied weights) when absent.
+    """
+    layers = []
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        layers.append({
+            "attn_norm": _t(sd, p + "input_layernorm.weight"),
+            "wq": _t(sd, p + "self_attn.q_proj.weight").T,
+            "wk": _t(sd, p + "self_attn.k_proj.weight").T,
+            "wv": _t(sd, p + "self_attn.v_proj.weight").T,
+            "wo": _t(sd, p + "self_attn.o_proj.weight").T,
+            "mlp_norm": _t(sd, p + "post_attention_layernorm.weight"),
+            "w_gate": _t(sd, p + "mlp.gate_proj.weight").T,
+            "w_up": _t(sd, p + "mlp.up_proj.weight").T,
+            "w_down": _t(sd, p + "mlp.down_proj.weight").T,
+        })
+    lm_head = (
+        _t(sd, "lm_head.weight").T
+        if "lm_head.weight" in sd
+        else _t(sd, "model.embed_tokens.weight").T
+    )
+    return {
+        "embed": _t(sd, "model.embed_tokens.weight"),
+        "final_norm": _t(sd, "model.norm.weight"),
+        "lm_head": lm_head,
+        "layers": layers,
+    }
+
+
+def load_hf_llama(model, dtype: str = "bfloat16") -> tuple[LlamaConfig, dict]:
+    """(cfg, params) from a live HF ``LlamaForCausalLM`` instance."""
+    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    cfg = config_from_hf(model.config, dtype=dtype)
+    return cfg, params_from_hf_state_dict(sd, cfg)
