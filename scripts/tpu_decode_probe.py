@@ -1,0 +1,96 @@
+"""Silicon probe for the serving path: paged-KV decode latency/throughput.
+
+First perf evidence for the paged-attention kernel (kernels/paged_kv.py —
+the TPU counterpart of the reference's kernel/cutedsl/paged_kv.py): decode
+one token against an 8k (and 32k) paged context, slope-timed, reporting
+per-token attention latency and the implied tokens/s for the attention
+component. Appends to ``benchmarks/history/decode_probe.csv``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if "--smoke" in sys.argv:
+    # local correctness smoke: the axon sitecustomize force-pins
+    # JAX_PLATFORMS, so only jax.config reliably selects CPU
+    os.environ.setdefault("MAGI_ATTENTION_PALLAS_INTERPRET", "1")
+    jax.config.update("jax_platforms", "cpu")
+
+if "--smoke" not in sys.argv:
+    # persistent cache is TPU-only (reloading CPU AOT entries can SIGILL
+    # on feature mismatch — ADVICE r2)
+    try:
+        from magiattention_tpu.utils.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache()
+    except Exception:
+        pass
+
+import jax.numpy as jnp
+import numpy as np
+
+from magiattention_tpu.benchmarking.bench import do_bench_scan_slope
+from magiattention_tpu.benchmarking.perf_report import append_row
+from magiattention_tpu.kernels.paged_kv import (
+    PagedKVCache, append_kv, assign_pages, paged_attn,
+)
+
+HQ, HK, D = 16, 8, 128
+PAGE = 128
+
+
+def probe(ctx_len: int) -> None:
+    rng = np.random.default_rng(0)
+    n_pages = ctx_len // PAGE + 2
+    cache = PagedKVCache.create(
+        num_pages=n_pages, page_size=PAGE, n_kv_heads=HK, head_dim=D,
+        max_seqs=1, max_pages_per_seq=n_pages, dtype=jnp.bfloat16,
+    )
+    cache = assign_pages(cache, 0, np.arange(n_pages, dtype=np.int32))
+    k_ctx = jnp.asarray(rng.standard_normal((ctx_len, HK, D)), jnp.bfloat16)
+    v_ctx = jnp.asarray(rng.standard_normal((ctx_len, HK, D)), jnp.bfloat16)
+    cache = append_kv(cache, 0, k_ctx, v_ctx)
+
+    q1 = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.bfloat16)
+
+    def decode_attn(q):
+        o, _ = paged_attn(q, cache, seq_id=0, q_start=ctx_len - 1,
+                          max_pages=n_pages)
+        return o.astype(jnp.bfloat16)
+
+    ms = do_bench_scan_slope(decode_attn, q1, verbose=True)
+    toks = 1e3 / ms
+    print(
+        f"ctx={ctx_len}: decode attn {ms:.3f} ms/token "
+        f"({toks:,.0f} tok/s attention-side)",
+        flush=True,
+    )
+    append_row("decode_probe", {
+        "ctx": ctx_len, "ms_per_token": round(ms, 4),
+        "tok_per_s_attn": round(toks, 1), "page_size": PAGE,
+        "hq": HQ, "hk": HK, "d": D,
+    })
+
+
+def main() -> int:
+    print("backend:", jax.default_backend(), jax.devices(), flush=True)
+    ctxs = (256,) if "--smoke" in sys.argv else (8192, 32768)
+    for ctx in ctxs:
+        try:
+            probe(ctx)
+        except Exception as e:  # noqa: BLE001
+            print(f"ctx={ctx}: FAIL {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -94,6 +94,8 @@ run_queue() {
   # load-balance evidence: unpadded min/max-W rank timings + padding tax
   # for BASELINE configs 3 (causal) and 4 (video) on the real CP=8 plans
   run_step 1800 ".tpu_logs/${TS}_balance.log" python -u scripts/tpu_rank_balance.py || return
+  # serving path: paged-KV decode latency at 8k/32k context
+  run_step 900 ".tpu_logs/${TS}_decode.log" python -u scripts/tpu_decode_probe.py || return
   run_step 1200 ".tpu_logs/${TS}_calibrate.log" python -u scripts/tpu_calibrate.py || return
   run_step 1200 ".tpu_logs/${TS}_profile.log" python -u scripts/tpu_profile_ffa.py .tpu_logs/ffa_trace
   # unproven-on-silicon step last so its failure can't cost the trace
