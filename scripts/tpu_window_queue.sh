@@ -102,6 +102,9 @@ run_queue() {
   run_step 900 ".tpu_logs/${TS}_overlap.log" python -u scripts/tpu_overlap_tax.py
 }
 
+# 45 s between probes: a failed probe already burns its 90 s timeout, so
+# the worst-case window-discovery latency is ~2.25 min against windows
+# observed as short as ~4 min.
 while true; do
   echo "[$(date -u +%H:%M:%S)] probe" >> "$LOG"
   if probe; then
@@ -109,5 +112,5 @@ while true; do
     run_queue
     echo "[$(date -u +%H:%M:%S)] QUEUE DONE — resuming probes" >> "$LOG"
   fi
-  sleep 120
+  sleep 45
 done
