@@ -55,27 +55,30 @@ def _t(sd, key):
     return jnp.asarray(np.asarray(w, dtype=np.float32))
 
 
-def params_from_hf_state_dict(sd: dict, cfg: LlamaConfig) -> dict:
-    """HF LlamaForCausalLM state dict (tensors or arrays) -> params pytree.
+def _sd_numpy(model) -> dict:
+    """State dict -> fp32 numpy (``.float()`` first: torch bf16 tensors —
+    how any real-size checkpoint is loaded — don't support ``.numpy()``)."""
+    return {
+        k: v.detach().cpu().float().numpy()
+        for k, v in model.state_dict().items()
+    }
 
-    Accepts torch tensors (call ``.detach().cpu()`` upstream or pass
-    ``{k: v.numpy() for ...}``) or numpy arrays. ``lm_head.weight`` falls
-    back to the embedding (tied weights) when absent.
-    """
-    layers = []
-    for i in range(cfg.n_layers):
-        p = f"model.layers.{i}."
-        layers.append({
-            "attn_norm": _t(sd, p + "input_layernorm.weight"),
-            "wq": _t(sd, p + "self_attn.q_proj.weight").T,
-            "wk": _t(sd, p + "self_attn.k_proj.weight").T,
-            "wv": _t(sd, p + "self_attn.v_proj.weight").T,
-            "wo": _t(sd, p + "self_attn.o_proj.weight").T,
-            "mlp_norm": _t(sd, p + "post_attention_layernorm.weight"),
-            "w_gate": _t(sd, p + "mlp.gate_proj.weight").T,
-            "w_up": _t(sd, p + "mlp.up_proj.weight").T,
-            "w_down": _t(sd, p + "mlp.down_proj.weight").T,
-        })
+
+def _attn_layer_entries(sd: dict, p: str) -> dict:
+    """The backbone (attention + norms) per-layer mapping — shared by the
+    Llama and Mixtral converters so a mapping fix reaches both."""
+    return {
+        "attn_norm": _t(sd, p + "input_layernorm.weight"),
+        "wq": _t(sd, p + "self_attn.q_proj.weight").T,
+        "wk": _t(sd, p + "self_attn.k_proj.weight").T,
+        "wv": _t(sd, p + "self_attn.v_proj.weight").T,
+        "wo": _t(sd, p + "self_attn.o_proj.weight").T,
+        "mlp_norm": _t(sd, p + "post_attention_layernorm.weight"),
+    }
+
+
+def _top_level_entries(sd: dict, layers: list) -> dict:
+    """embed/final_norm/lm_head (tied-weight fallback) + layers."""
     lm_head = (
         _t(sd, "lm_head.weight").T
         if "lm_head.weight" in sd
@@ -89,8 +92,89 @@ def params_from_hf_state_dict(sd: dict, cfg: LlamaConfig) -> dict:
     }
 
 
+def params_from_hf_state_dict(sd: dict, cfg: LlamaConfig) -> dict:
+    """HF LlamaForCausalLM state dict (tensors or arrays) -> params pytree.
+
+    Accepts torch tensors (call ``.detach().cpu()`` upstream or pass
+    ``{k: v.numpy() for ...}``) or numpy arrays. ``lm_head.weight`` falls
+    back to the embedding (tied weights) when absent.
+    """
+    layers = []
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        layers.append({
+            **_attn_layer_entries(sd, p),
+            "w_gate": _t(sd, p + "mlp.gate_proj.weight").T,
+            "w_up": _t(sd, p + "mlp.up_proj.weight").T,
+            "w_down": _t(sd, p + "mlp.down_proj.weight").T,
+        })
+    return _top_level_entries(sd, layers)
+
+
 def load_hf_llama(model, dtype: str = "bfloat16") -> tuple[LlamaConfig, dict]:
     """(cfg, params) from a live HF ``LlamaForCausalLM`` instance."""
-    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
     cfg = config_from_hf(model.config, dtype=dtype)
-    return cfg, params_from_hf_state_dict(sd, cfg)
+    return cfg, params_from_hf_state_dict(_sd_numpy(model), cfg)
+
+
+# ---------------------------------------------------------------------------
+# Mixtral -> MoE family
+# ---------------------------------------------------------------------------
+
+
+def moe_config_from_hf(hf_config, dtype: str = "bfloat16",
+                       capacity_factor: float = 1.25):
+    """MoEConfig from a HF ``MixtralConfig`` (gating matches: softmax over
+    the selected top-k router logits). Backbone fields come through
+    :func:`config_from_hf` so a new base-field mapping reaches both
+    families."""
+    import dataclasses
+
+    from .moe import MoEConfig
+
+    base = dataclasses.asdict(config_from_hf(hf_config, dtype=dtype))
+    return MoEConfig(
+        **base,
+        n_experts=hf_config.num_local_experts,
+        top_k=hf_config.num_experts_per_tok,
+        capacity_factor=capacity_factor,
+    )
+
+
+def moe_params_from_hf_state_dict(sd: dict, cfg) -> dict:
+    """HF MixtralForCausalLM state dict -> MoE params pytree.
+
+    Mixtral naming: ``block_sparse_moe.gate`` -> router;
+    experts.N.{w1,w3,w2} -> w_gate/w_up/w_down (stacked on the expert dim,
+    transposed to (in, out))."""
+    layers = []
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        m = p + "block_sparse_moe."
+        layers.append({
+            **_attn_layer_entries(sd, p),
+            "router": _t(sd, m + "gate.weight").T,
+            "w_gate": jnp.stack([
+                _t(sd, m + f"experts.{e}.w1.weight").T
+                for e in range(cfg.n_experts)
+            ]),
+            "w_up": jnp.stack([
+                _t(sd, m + f"experts.{e}.w3.weight").T
+                for e in range(cfg.n_experts)
+            ]),
+            "w_down": jnp.stack([
+                _t(sd, m + f"experts.{e}.w2.weight").T
+                for e in range(cfg.n_experts)
+            ]),
+        })
+    return _top_level_entries(sd, layers)
+
+
+def load_hf_mixtral(model, dtype: str = "bfloat16",
+                    capacity_factor: float = 1.25):
+    """(cfg, params) from a live HF ``MixtralForCausalLM``. For exact
+    parity checks against the torch forward use a LARGE capacity_factor
+    (HF routes every token to its top-k experts with no capacity drops)."""
+    cfg = moe_config_from_hf(model.config, dtype=dtype,
+                             capacity_factor=capacity_factor)
+    return cfg, moe_params_from_hf_state_dict(_sd_numpy(model), cfg)
