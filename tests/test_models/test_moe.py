@@ -190,6 +190,34 @@ def test_moe_train_step_no_ep_zero3_sharding():
     assert losses[-1] < losses[0], losses
 
 
+def test_moe_remat_matches_no_remat():
+    """cfg.remat (jax.checkpoint around the layer incl. the EP shard_map)
+    must not change the forward numerics."""
+    cfg = dataclasses.replace(CFG, capacity_factor=8.0)
+    params = init_moe_params(cfg, jax.random.key(0))
+    tokens = np.arange(S, dtype=np.int32) % cfg.vocab_size
+    _, key = _make_key(4)
+    logits, _ = moe_forward(
+        params, cfg, jnp.asarray(tokens), key, ep_axis="cp"
+    )
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    logits_r, _ = moe_forward(
+        params, cfg_r, jnp.asarray(tokens), key, ep_axis="cp"
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(logits_r), atol=1e-5, rtol=1e-5
+    )
+    # and grads flow under remat
+    def loss(p):
+        lg, aux = moe_forward(p, cfg_r, jnp.asarray(tokens), key,
+                              ep_axis="cp")
+        return jnp.sum(lg * lg) * 1e-4 + aux
+
+    g = jax.grad(loss)(params)
+    flat, _ = jax.tree_util.tree_flatten(g)
+    assert all(np.isfinite(np.asarray(x)).all() for x in flat)
+
+
 def test_moe_train_step_decreases_loss():
     mesh, key = _make_key(4)
     params = init_moe_params(CFG, jax.random.key(0))
