@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm.primitives import cast_rows, reduce_rows
 from ..env import general as env_general
+from ..env import kernel as env_kernel
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
@@ -185,8 +186,21 @@ class DynamicDistAttnRuntime:
 
     def __post_init__(self) -> None:
         p = self.plan
-        bq, bk = default_blocks(p.q_buf_len, p.k_buf_len,
-                                self.block_q, self.block_k)
+        blk_q, blk_k = self.block_q, self.block_k
+        if blk_q is None and blk_k is None and not env_kernel.ffa_blocks_pinned():
+            from ..kernels.tile_policy import (
+                auto_tile_enabled, choose_blocks_multi,
+            )
+
+            if auto_tile_enabled():
+                blk_q, blk_k = choose_blocks_multi(
+                    [
+                        (a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
+                        for a in p.attn_args
+                    ],
+                    p.q_buf_len, p.k_buf_len,
+                )
+        bq, bk = default_blocks(p.q_buf_len, p.k_buf_len, blk_q, blk_k)
         self._bq, self._bk = bq, bk
         self._arrays, self._dims = _stack_plans(
             p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk

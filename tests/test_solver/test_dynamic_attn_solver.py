@@ -163,6 +163,19 @@ def test_qo_comm_pipeline(mask_name, alg, monkeypatch):
     _run_pipeline(mask_name, alg, backend=None, backward=False)
 
 
+def test_qo_comm_auto_tile(monkeypatch):
+    """MAGI_ATTENTION_FFA_AUTO_TILE reaches the dynamic (qo-comm) runtime
+    too — same oracle with the policy on."""
+    monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_AUTO_TILE", "1")
+    monkeypatch.delenv("MAGI_ATTENTION_FFA_BLOCK_Q", raising=False)
+    monkeypatch.delenv("MAGI_ATTENTION_FFA_BLOCK_K", raising=False)
+    _run_pipeline(
+        "shared_prefix", DynamicAttnAlgType.BINARY_GREEDY,
+        backend="ffa", backward=True,
+    )
+
+
 @pytest.mark.parametrize("backend", ["sdpa", "ffa"])
 def test_qo_comm_backward(backend, monkeypatch):
     monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
