@@ -1,0 +1,30 @@
+# Developer entry points (ref: the reference repo's makefile test/coverage
+# targets). Everything runs on the virtual CPU mesh unless noted.
+
+PY ?= python
+
+.PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead
+
+test:  ## fast suite (excludes slow scale tests)
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+test-all:  ## everything, including the 262k/131k scale oracles
+	$(PY) -m pytest tests/ -q
+
+test-slow:  ## only the slow-marked scale tests
+	$(PY) -m pytest tests/ -q -m slow
+
+bench:  ## the driver's headline benchmark (TPU when reachable)
+	$(PY) bench.py
+
+dryrun:  ## 8-virtual-device multi-chip training-step validation
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+smoke:  ## kernel correctness on the attached TPU chip
+	$(PY) scripts/tpu_smoke.py
+
+queue:  ## background chip-window experiment poller
+	nohup bash scripts/tpu_window_queue.sh > /dev/null 2>&1 & echo "queue pid $$!"
+
+fit-overhead:  ## fit tile_policy.OVERHEAD_ELEMS from recorded sweeps
+	$(PY) scripts/fit_tile_overhead.py
