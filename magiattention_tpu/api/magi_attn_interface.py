@@ -75,12 +75,14 @@ _most_recent_key: DistAttnRuntimeKey | None = None
 def _auto_chunk_size(
     total_seqlen: int, cp_size: int, uneven_shard: bool = False
 ) -> int:
-    """Pick the largest chunk <= 512 giving every rank >= 4 chunks (ref
-    :644-655 auto-derivation). Uneven shard only needs
-    ``chunk_size | total_seqlen``; even shard additionally needs the chunk
-    count divisible by cp_size."""
+    """Pick the largest chunk <= 512 giving every rank >=
+    ``MAGI_ATTENTION_MIN_CHUNKS_PER_RANK`` chunks (ref :644-655
+    auto-derivation from env.general.min_chunks_per_rank). Uneven shard only
+    needs ``chunk_size | total_seqlen``; even shard additionally needs the
+    chunk count divisible by cp_size."""
     shard = total_seqlen // cp_size
-    target = min(512, max(1, shard // 4))
+    min_chunks = max(1, env_general.min_chunks_per_rank())
+    target = min(512, max(1, shard // min_chunks))
     for cs in range(target, 0, -1):
         if uneven_shard:
             if total_seqlen % cs == 0:

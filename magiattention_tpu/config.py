@@ -10,6 +10,7 @@ from .common.enum import (
     DynamicAttnAlgType,
     OverlapAlgType,
 )
+from .env.comm import split_alignment as _env_split_alignment
 
 
 @dataclass(frozen=True)
@@ -69,9 +70,11 @@ class GrpCollConfig:
     Attributes:
         split_alignment: pad per-destination split sizes to this multiple so
             `jax.lax.all_to_all` sees equal static splits (TPU lane = 128).
+            Defaults from ``MAGI_ATTENTION_SPLIT_ALIGNMENT``
+            (env.comm.split_alignment); an explicit value here wins.
     """
 
-    split_alignment: int = 128
+    split_alignment: int = field(default_factory=_env_split_alignment)
 
 
 @dataclass(frozen=True)

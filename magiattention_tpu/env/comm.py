@@ -16,17 +16,38 @@ def is_qo_comm_enable() -> bool:
 
 
 def is_fwd_high_precision_reduce_enable() -> bool:
-    """Reduce partial out in fp32 instead of the compute dtype."""
-    return _get_bool("MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE", default=True)
+    """Return partial out across ranks in fp32 instead of the compute dtype.
+
+    Applies to the qo-comm (dynamic) runtime, where partial outputs travel
+    back to their owner rank for the lse merge
+    (functional/dynamic_dist_attn.py _dyn_fwd_impl). Doubles that wire
+    volume for better merge precision. The static (kv-comm) runtime never
+    sends partial out, so this is a no-op there — same as the reference
+    (_reduce_partial_out_lse is qo-comm-only, dist_attn.py:1979).
+
+    Default ``0``, matching the reference (env/comm.py:106).
+    """
+    return _get_bool("MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE")
 
 
 def is_bwd_high_precision_reduce_enable() -> bool:
-    """Reduce partial dkv in fp32 instead of the compute dtype."""
-    return _get_bool("MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE", default=True)
+    """Reduce partial dq/dk/dv across ranks in fp32 instead of the compute
+    dtype (ref _reduce_partial_dkv, dist_attn.py:2123; default ``0`` matching
+    env/comm.py:123). Doubles backward comm volume; removes the cp-way
+    low-precision summation error.
+
+    Consumed by functional/dist_attn.py (hp_group_cast custom-VJP wire) and
+    functional/dynamic_dist_attn.py (_dyn_bwd partial dtype choice).
+    """
+    return _get_bool("MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE")
 
 
 def split_alignment() -> int:
-    """Pad collective split sizes to a multiple of this (TPU lane alignment)."""
+    """Pad collective split sizes to a multiple of this (TPU lane alignment).
+
+    Consumed as the default of ``GrpCollConfig.split_alignment`` (config.py);
+    an explicit config value wins over the env.
+    """
     return _get_int("MAGI_ATTENTION_SPLIT_ALIGNMENT", 128)
 
 

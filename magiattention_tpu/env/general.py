@@ -41,17 +41,16 @@ def precision() -> str:
     return _get_str("MAGI_ATTENTION_PRECISION", "default").lower()
 
 
-def is_deterministic_mode_enable() -> bool:
-    """Deterministic reduction ordering for partial-result merging."""
-    return _get_bool("MAGI_ATTENTION_DETERMINISTIC_MODE")
-
-
 def is_profile_mode_enable() -> bool:
+    """Wrap hot-path functions in profiler scopes (utils/profiling.py
+    instrument_scope — the ref nvtx.instrument_nvtx analogue, nvtx.py:81)."""
     return _get_bool("MAGI_ATTENTION_PROFILE_MODE")
 
 
 def is_range_merge_enable() -> bool:
-    """Merge adjacent compatible slices before kernel launch."""
+    """Merge band-compatible adjacent slices before kernel planning
+    (kernels/ffa_plan.py build_ffa_plan -> mask_utils.merge_band_slices;
+    the ref merges at its kernel entry, functional/flex_flash_attn.py:87)."""
     return _get_bool("MAGI_ATTENTION_RANGE_MERGE", default=True)
 
 
@@ -61,7 +60,10 @@ def runtime_dict_size() -> int:
 
 
 def min_chunks_per_rank() -> int:
-    return _get_int("MAGI_ATTENTION_MIN_CHUNKS_PER_RANK", 1)
+    """Lower bound on dispatch chunks per rank when auto-deriving chunk_size
+    (api/magi_attn_interface.py _auto_chunk_size; ref env/general.py:263 —
+    default there is 8, here 4: TPU plans favor fewer, larger chunks)."""
+    return _get_int("MAGI_ATTENTION_MIN_CHUNKS_PER_RANK", 4)
 
 
 def is_cpp_backend_enable() -> bool:
@@ -79,8 +81,10 @@ def is_interpret_mode_enable() -> bool:
 ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     "MAGI_ATTENTION_KERNEL_BACKEND",
     "MAGI_ATTENTION_PRECISION",
-    "MAGI_ATTENTION_DETERMINISTIC_MODE",
     "MAGI_ATTENTION_RANGE_MERGE",
+    # HP reduce changes the traced collective program (wire dtype)
+    "MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE",
+    "MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE",
     "MAGI_ATTENTION_MIN_CHUNKS_PER_RANK",
     "MAGI_ATTENTION_CPP_BACKEND",
     "MAGI_ATTENTION_PALLAS_INTERPRET",

@@ -52,11 +52,6 @@ def ffa_blocks_pinned() -> bool:
     )
 
 
-def ffa_max_slices() -> int:
-    """Static upper bound on slice count per AttnArg (padding bucket)."""
-    return _get_int("MAGI_ATTENTION_FFA_MAX_SLICES", 64)
-
-
 def ffa_native_plan() -> str:
     """Native (C) FFA work-list builder: 'auto' (use when the native lib
     builds; silently fall back), '1' (require), '0' (pure Python). Unlike

@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from ..comm.primitives import cast_rows
+from ..comm.primitives import cast_rows, reduce_rows
 from ..env import comm as env_comm
 from ..env import general as env_general
 from ..env import kernel as env_kernel
@@ -49,6 +49,7 @@ from ..kernels.ffa import (
 from ..kernels.ffa_plan import build_ffa_plan, pad_plan
 from ..meta.collection.calc_meta import AttnArg, CalcMeta
 from ..meta.collection.comm_meta import CommMeta
+from ..utils.profiling import instrument_scope, profile_scope
 from .utils import lse_weighted_reduce
 
 
@@ -76,24 +77,34 @@ def _multi_ffa(q, ks, vs, arrays_list, params_list):
 def _multi_ffa_impl(q, ks, vs, arrays_list, params_list):
     outs, lses = [], []
     ml = None
-    for k, v, arrs, prm in zip(ks, vs, arrays_list, params_list):
+    for i, (k, v, arrs, prm) in enumerate(
+        zip(ks, vs, arrays_list, params_list)
+    ):
         sqp = prm.num_q_tiles * prm.block_q
         skp = prm.num_k_tiles * prm.block_k
         q_t = _head_major(q, sqp)
-        k_t = _head_major(k, skp)
-        v_t = _head_major(v, skp)
-        out_t, lse_t, ml_p = ffa_fwd_pallas_dispatch(
-            prm, *arrs[:3], q_t, k_t, v_t
-        )
+        # compute always in q's dtype: k/v parts may arrive fp32 from the
+        # high-precision-reduce cast (hp_group_cast) so their cotangents
+        # stay fp32 through the wire reduce
+        k_t = _head_major(k.astype(q.dtype), skp)
+        v_t = _head_major(v.astype(q.dtype), skp)
+        with profile_scope(f"ffa_fwd_stage{i}"):
+            out_t, lse_t, ml_p = ffa_fwd_pallas_dispatch(
+                prm, *arrs[:3], q_t, k_t, v_t
+            )
         outs.append(out_t.transpose(1, 0, 2)[: q.shape[0]])
         lses.append(lse_t.T[: q.shape[0]])
         ml = ml_p if ml is None else jnp.maximum(ml, ml_p)
-    out, lse = lse_weighted_reduce(jnp.stack(outs), jnp.stack(lses))
+    with profile_scope("lse_merge"):
+        out, lse = lse_weighted_reduce(jnp.stack(outs), jnp.stack(lses))
     return out, lse, ml, outs, lses
 
 
 def _multi_ffa_fwd(q, ks, vs, arrays_list, params_list):
     out, lse, ml, _, _ = _multi_ffa_impl(q, ks, vs, arrays_list, params_list)
+    # residuals keep the PRIMAL-dtype parts: under HP reduce the remote
+    # parts are fp32 (2x residual HBM — the flag's documented cost) so
+    # their cotangents legally leave fp32 for the wire reduce
     return (out, lse, ml), (q, ks, vs, out, lse, arrays_list)
 
 
@@ -111,8 +122,8 @@ def _multi_ffa_bwd(params_list, res, cts):
         sqp = prm.num_q_tiles * prm.block_q
         skp = prm.num_k_tiles * prm.block_k
         q_t = _head_major(q, sqp)
-        k_t = _head_major(k, skp)
-        v_t = _head_major(v, skp)
+        k_t = _head_major(k.astype(q.dtype), skp)
+        v_t = _head_major(v.astype(q.dtype), skp)
         do_t = _head_major(do, sqp)
         # pad lse with -inf, delta with 0 for rows beyond sq
         lse_t = jnp.pad(
@@ -120,13 +131,16 @@ def _multi_ffa_bwd(params_list, res, cts):
         ).T
         delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
         dq_arrs, dkv_arrs = _bwd_plan_slices(arrs)
-        dq_t = ffa_bwd_dq_pallas_dispatch(
-            prm, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
-        )
-        dk_t, dv_t = _ffa_bwd_dkv_pallas(
-            prm, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
-        )
-        # dk/dv already per kv head (dkv kernel sums the GQA group)
+        with profile_scope("ffa_bwd_dq"):
+            dq_t = ffa_bwd_dq_pallas_dispatch(
+                prm, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
+            )
+        with profile_scope("ffa_bwd_dkv"):
+            dk_t, dv_t = _ffa_bwd_dkv_pallas(
+                prm, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
+            )
+        # dk/dv already per kv head (dkv kernel sums the GQA group); the
+        # kernels emit fp32, so the casts are identity under HP reduce
         dq = dq_t.transpose(1, 0, 2)[:sq].astype(q.dtype)
         dq_total = dq if dq_total is None else dq_total + dq
         dks.append(dk_t.transpose(1, 0, 2)[: k.shape[0]].astype(k.dtype))
@@ -135,6 +149,57 @@ def _multi_ffa_bwd(params_list, res, cts):
 
 
 _multi_ffa.defvjp(_multi_ffa_fwd, _multi_ffa_bwd)
+
+
+def _cast_any(x, ops, kind, axis_name):
+    """cast_rows extended with the hierarchical tier
+    (kind ``("hier", dcn_axis, ici_axis)``)."""
+    if kind[0] == "hier":
+        from ..comm.hier import hier_group_cast_rows
+
+        return hier_group_cast_rows(
+            x, ops[0], ops[1], ops[2], ops[3], kind[1], kind[2]
+        )
+    return cast_rows(x, ops, kind, axis_name)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def hp_group_cast(x, ops, kind, axis_name, shard_len, in_dtype):
+    """GroupCast whose transpose (GroupReduce) runs in fp32 on the wire.
+
+    Forward sends x in its own dtype (bf16 wire, unchanged) and upcasts the
+    receive buffer to fp32; backward reduces the fp32 cotangent through the
+    collective and casts to x's dtype only AFTER the cross-rank sum — the
+    reference's high-precision partial-grad reduce (_reduce_partial_dkv,
+    magi_attention/functional/dist_attn.py:2123, enabled by
+    MAGI_ATTENTION_BACKWARD_HIGH_PRECISION_REDUCE). Doubles backward comm
+    bytes; removes the cp-way low-precision summation error. XLA folds the
+    fwd up/down-cast pair around the kernel's compute cast, so the fp32
+    receive buffer never persists.
+    """
+    return _cast_any(x, ops, kind, axis_name).astype(jnp.float32)
+
+
+def _hp_group_cast_fwd(x, ops, kind, axis_name, shard_len, in_dtype):
+    return hp_group_cast(x, ops, kind, axis_name, shard_len, in_dtype), ops
+
+
+def _hp_group_cast_bwd(kind, axis_name, shard_len, in_dtype, res, g):
+    ops = res
+    if kind[0] == "hier":
+        # transpose via jax.vjp of the cast itself (same trick as the
+        # ragged tier in reduce_rows) — no hand-maintained mirror plan
+        zeros = jnp.zeros((shard_len, *g.shape[1:]), g.dtype)
+        _, vjp_fn = jax.vjp(
+            lambda z: _cast_any(z, ops, kind, axis_name), zeros
+        )
+        (red,) = vjp_fn(g)
+    else:
+        red = reduce_rows(g, ops, kind, axis_name, shard_len)
+    return red.astype(in_dtype), None
+
+
+hp_group_cast.defvjp(_hp_group_cast_fwd, _hp_group_cast_bwd)
 
 
 def _ragged_arrays(s) -> tuple[jax.Array, ...]:
@@ -353,30 +418,44 @@ class DistAttnRuntime:
             for f in ("q_ranges", "k_ranges", "d_lo", "d_hi")
         )
 
+    def _kind(self, stage: int):
+        """Static lowering descriptor for one stage — the ONE place the
+        hier-vs-flat branch is decided (``_cast_any`` dispatches on it)."""
+        if self._hier:
+            dcn_axis, ici_axis = self.cp_axis
+            return ("hier", dcn_axis, ici_axis)
+        return self._cast_kinds[stage]
+
+    def _axis(self):
+        return None if self._hier else self.cp_axis
+
     def _cast(self, x, ops, stage: int = 0):
         """One stage's GroupCast inside shard_map (flat / pp / hierarchical)."""
-        if self._hier:
-            from ..comm.hier import hier_group_cast_rows
-
-            dcn_axis, ici_axis = self.cp_axis
-            return hier_group_cast_rows(
-                x, ops[0][0], ops[1][0], ops[2][0], ops[3][0],
-                dcn_axis, ici_axis,
+        with profile_scope(f"group_cast_stage{stage}"):
+            return _cast_any(
+                x, tuple(o[0] for o in ops), self._kind(stage), self._axis()
             )
-        return cast_rows(
-            x, tuple(o[0] for o in ops), self._cast_kinds[stage],
-            self.cp_axis,
-        )
 
-    def _cast_kv(self, k, v, ops, stage: int = 0):
+    def _cast_kv(self, k, v, ops, stage: int = 0, hp: bool = False):
         """Fused K|V GroupCast: one collective for both tensors (the
         reference's asymmetric-KV comm fuses along head_dim the same way,
-        comm_meta.py:588-591 — valid for any d_k/d_v since rows coincide)."""
+        comm_meta.py:588-591 — valid for any d_k/d_v since rows coincide).
+        ``hp=True`` routes through :func:`hp_group_cast` so the backward
+        reduce of the dkv cotangents runs in fp32 on the wire."""
+        cast = self._cast_hp if hp else self._cast
         if k.dtype == v.dtype and k.shape[1] == v.shape[1]:
             kv = jnp.concatenate([k, v], axis=-1)
-            kv_r = self._cast(kv, ops, stage)
+            kv_r = cast(kv, ops, stage)
             return kv_r[..., : k.shape[-1]], kv_r[..., k.shape[-1]:]
-        return self._cast(k, ops, stage), self._cast(v, ops, stage)
+        return cast(k, ops, stage), cast(v, ops, stage)
+
+    def _cast_hp(self, x, ops, stage: int = 0):
+        """One stage's GroupCast with the fp32-wire backward reduce."""
+        with profile_scope(f"group_cast_stage{stage}"):
+            return hp_group_cast(
+                x, tuple(o[0] for o in ops), self._kind(stage),
+                self._axis(), x.shape[0], x.dtype.name,
+            )
 
     @property
     def backend(self) -> str:
@@ -399,6 +478,7 @@ class DistAttnRuntime:
             emit_max_logits=emit_max_logits,
         )
 
+    @instrument_scope(name="DistAttnRuntime.calc_attn")
     def calc_attn(
         self,
         q: jax.Array,
@@ -492,15 +572,25 @@ class DistAttnRuntime:
             )
             return fn(q, k, v, self._cast_ops, self._merged_slices)
 
+        # fp32 wire reduce for partial dkv (ref decision at dist_attn.py
+        # :243-248; default off there and here). The sdpa/jnp backends keep
+        # plain AD (they are fp32-exact test backends already).
+        hp_bwd = env_comm.is_bwd_high_precision_reduce_enable()
+
         if not self.use_overlap:
             params = self._ffa_params(
                 self._merged_dims, scale, group, return_max_logits
             )
 
             def f(q, k, v, cast_ops, arrays):
-                kv_parts_k, kv_parts_v = [k], [v]
+                # under HP reduce the receive buffers are fp32, so the
+                # local shard joins the concat upcast (its cotangent cast
+                # back is device-local — no wire cost)
+                k0 = k.astype(jnp.float32) if hp_bwd else k
+                v0 = v.astype(jnp.float32) if hp_bwd else v
+                kv_parts_k, kv_parts_v = [k0], [v0]
                 for st, ops in enumerate(cast_ops):
-                    kr, vr = self._cast_kv(k, v, ops, st)
+                    kr, vr = self._cast_kv(k, v, ops, st, hp=hp_bwd)
                     kv_parts_k.append(kr)
                     kv_parts_v.append(vr)
                 k_all = jnp.concatenate(kv_parts_k, axis=0)
@@ -542,7 +632,9 @@ class DistAttnRuntime:
             # compute, XLA overlaps them with the host + earlier-stage kernels
             ks, vs = [k], [v]
             for st, ops in enumerate(cast_ops):
-                kr, vr = self._cast_kv(k, v, ops, st)
+                # hp: remote parts arrive fp32; _multi_ffa is
+                # dtype-polymorphic per part, so the local shard stays bf16
+                kr, vr = self._cast_kv(k, v, ops, st, hp=hp_bwd)
                 ks.append(kr)
                 vs.append(vr)
             arrays_list = (tuple(a[0] for a in host_arrays),) + tuple(

@@ -44,6 +44,7 @@ from ..kernels.ffa import (
     ffa_attn_with_plan,
 )
 from ..meta.collection.dynamic_meta import DynamicAttnPlan
+from ..utils.profiling import instrument_scope, profile_scope
 from .dist_attn import _head_major, _stack_plans
 from .utils import lse_weighted_reduce
 
@@ -75,23 +76,29 @@ def _dyn_attn_shard(q, k, v, static, axis, comm, arrays):
 
 
 def _dyn_fwd_impl(q, k, v, static, axis, comm, arrays):
-    params, shard, kv_shard, kinds = static
+    params, shard, kv_shard, kinds, fwd_hp, _ = static
     q_kind, k_kind, r_kind = kinds
     (q_ops, k_ops, r_ops, (merge_idx,)) = comm
-    q_rem = cast_rows(q, q_ops, q_kind, axis)
-    q_buf = jnp.concatenate([q, q_rem], axis=0)
-    k_rem = cast_rows(k, k_ops, k_kind, axis)
-    v_rem = cast_rows(v, k_ops, k_kind, axis)
-    k_buf = jnp.concatenate([k, k_rem], axis=0)
-    v_buf = jnp.concatenate([v, v_rem], axis=0)
-    out_buf, lse_buf, ml = ffa_attn_with_plan(
-        q_buf, k_buf, v_buf, arrays, params,
-        return_max_logits=True,  # ml is constant -inf unless params emit it
-    )
-    ret_out = cast_rows(out_buf, r_ops, r_kind, axis)
+    with profile_scope("qo_comm_cast"):
+        q_rem = cast_rows(q, q_ops, q_kind, axis)
+        q_buf = jnp.concatenate([q, q_rem], axis=0)
+        k_rem = cast_rows(k, k_ops, k_kind, axis)
+        v_rem = cast_rows(v, k_ops, k_kind, axis)
+        k_buf = jnp.concatenate([k, k_rem], axis=0)
+        v_buf = jnp.concatenate([v, v_rem], axis=0)
+    with profile_scope("ffa_fwd_dyn"):
+        out_buf, lse_buf, ml = ffa_attn_with_plan(
+            q_buf, k_buf, v_buf, arrays, params,
+            return_max_logits=True,  # constant -inf unless params emit it
+        )
+    # fwd high-precision reduce (ref _reduce_partial_out_lse + env decision,
+    # dist_attn.py:243): partial out rows return to their owners in fp32 —
+    # 2x this wire, better lse-merge precision. lse is fp32 either way.
+    ret_src = out_buf.astype(jnp.float32) if fwd_hp else out_buf
+    ret_out = cast_rows(ret_src, r_ops, r_kind, axis)
     ret_lse = cast_rows(lse_buf, r_ops, r_kind, axis)
     out, lse = _merge_rows(out_buf, lse_buf, ret_out, ret_lse, merge_idx)
-    return out, lse, ml, q_buf, k_buf, v_buf
+    return out.astype(out_buf.dtype), lse, ml, q_buf, k_buf, v_buf
 
 
 def _dyn_fwd(q, k, v, static, axis, comm, arrays):
@@ -102,7 +109,7 @@ def _dyn_fwd(q, k, v, static, axis, comm, arrays):
 def _dyn_bwd(static, axis, res, cts):
     do, _, _ = cts  # lse/max_logits are auxiliary
     q, k, v, out, lse, comm, arrays = res
-    params, shard, kv_shard, kinds = static
+    params, shard, kv_shard, kinds, _, bwd_hp = static
     q_kind, k_kind, _ = kinds
     (q_ops, k_ops, _, _) = comm
 
@@ -154,6 +161,14 @@ def _dyn_bwd(static, axis, res, cts):
     dk_buf = dk_t.transpose(1, 0, 2)[: k_buf.shape[0]]
     dv_buf = dv_t.transpose(1, 0, 2)[: v_buf.shape[0]]
 
+    # the kernels emit fp32 partials; MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE
+    # keeps them fp32 through the wire reduce (2x bwd comm bytes, ref
+    # _reduce_partial_dq/_reduce_partial_dkv); default reduces in the input
+    # dtype (ref bwd_local_dkv_lp_init / bwd_local_dq_lp_init, :245-253)
+    if not bwd_hp:
+        dq_buf = dq_buf.astype(q.dtype)
+        dk_buf = dk_buf.astype(k.dtype)
+        dv_buf = dv_buf.astype(v.dtype)
     dq = dq_buf[:shard] + reduce_rows(
         dq_buf[shard:], q_ops, q_kind, axis, shard
     )
@@ -232,6 +247,7 @@ class DynamicDistAttnRuntime:
     def backend(self) -> str:
         return env_general.kernel_backend()
 
+    @instrument_scope(name="DynamicDistAttnRuntime.calc_attn")
     def calc_attn(
         self,
         q: jax.Array,
@@ -269,9 +285,13 @@ class DynamicDistAttnRuntime:
             interpret=_should_interpret(),
             emit_max_logits=return_max_logits,
         )
+        from ..env import comm as env_comm
+
         static = (
             params, p.shard_len, p.kv_shard_len,
             (self._q_kind, self._k_kind, self._r_kind),
+            env_comm.is_fwd_high_precision_reduce_enable(),
+            env_comm.is_bwd_high_precision_reduce_enable(),
         )
 
         def f(q, k, v, comm, arrays):
@@ -327,6 +347,9 @@ class DynamicDistAttnRuntime:
         )
 
         q_kind, k_kind, r_kind = self._q_kind, self._k_kind, self._r_kind
+        from ..env import comm as env_comm
+
+        fwd_hp = env_comm.is_fwd_high_precision_reduce_enable()
 
         def f(q, k, v, comm, slices):
             q_ops, k_ops, r_ops, (merge_idx,) = tuple(
@@ -346,11 +369,13 @@ class DynamicDistAttnRuntime:
                 q_buf, k_buf, v_buf, qr, kr, None,
                 softmax_scale=scale, softcap=softcap, d_lo=lo, d_hi=hi,
             )
-            ret_out = cast_rows(out_buf, r_ops, r_kind, axis)
+            ret_src = out_buf.astype(jnp.float32) if fwd_hp else out_buf
+            ret_out = cast_rows(ret_src, r_ops, r_kind, axis)
             ret_lse = cast_rows(lse_buf, r_ops, r_kind, axis)
             out, lse = _merge_rows(
                 out_buf, lse_buf, ret_out, ret_lse, merge_idx
             )
+            out = out.astype(out_buf.dtype)
             # lse is non-differentiable on the ffa backend (custom VJP drops
             # its cotangent); stop_gradient keeps the backends in agreement
             lse = jax.lax.stop_gradient(lse)

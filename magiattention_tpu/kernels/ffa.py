@@ -1183,13 +1183,22 @@ def ffa_fwd_pallas_dispatch(params: FFAParams, work_qt, work_kt, meta,
 
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _ffa_core(q_t, k_t, v_t, arrays, params: FFAParams):
-    return ffa_fwd_pallas_dispatch(params, *arrays[0:3], q_t, k_t, v_t)
+    # dtype-polymorphic: compute always runs in q's dtype; k/v may arrive
+    # fp32 (the high-precision wire-reduce path upcasts receive buffers so
+    # their COTANGENTS legally stay fp32 through the group-reduce — ref
+    # _reduce_partial_dkv, dist_attn.py:2123) and are cast down here.
+    kc, vc = k_t.astype(q_t.dtype), v_t.astype(q_t.dtype)
+    return ffa_fwd_pallas_dispatch(params, *arrays[0:3], q_t, kc, vc)
 
 
 def _ffa_core_fwd(q_t, k_t, v_t, arrays, params: FFAParams):
     out_t, lse_t, ml = ffa_fwd_pallas_dispatch(
-        params, *arrays[0:3], q_t, k_t, v_t
+        params, *arrays[0:3], q_t,
+        k_t.astype(q_t.dtype), v_t.astype(q_t.dtype),
     )
+    # residuals keep the PRIMAL-dtype k/v: under HP reduce that is fp32
+    # (2x residual HBM — the documented cost of the flag); the cotangents
+    # below then legally leave in fp32 for the wire reduce
     res = (q_t, k_t, v_t, out_t, lse_t, arrays)
     return (out_t, lse_t, ml), res
 
@@ -1200,18 +1209,20 @@ def _ffa_core_bwd(params: FFAParams, res, cts):
     # reference).
     do_t, _, _ = cts
     q_t, k_t, v_t, out_t, lse_t, arrays = res
+    kc, vc = k_t.astype(q_t.dtype), v_t.astype(q_t.dtype)
     dq_arrays, dkv_arrays = _bwd_plan_slices(arrays)
     delta_t = jnp.sum(
         do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
     )  # (hq, sqp)
     dq_t = ffa_bwd_dq_pallas_dispatch(
-        params, *dq_arrays, q_t, k_t, v_t, do_t, lse_t, delta_t
+        params, *dq_arrays, q_t, kc, vc, do_t, lse_t, delta_t
     )
     dk_t, dv_t = _ffa_bwd_dkv_pallas(
-        params, *dkv_arrays, q_t, k_t, v_t, do_t, lse_t, delta_t,
+        params, *dkv_arrays, q_t, kc, vc, do_t, lse_t, delta_t,
     )
     # dk/dv already come back per kv head: the dkv kernel accumulates the
-    # GQA group in-kernel (no host reshape-sum)
+    # GQA group in-kernel (no host reshape-sum). The kernels emit fp32; the
+    # casts below are identity when the primal k/v were fp32 (HP reduce).
     return (
         dq_t.astype(q_t.dtype),
         dk_t.astype(k_t.dtype),

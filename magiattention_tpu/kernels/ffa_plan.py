@@ -21,6 +21,7 @@ from functools import lru_cache
 import numpy as np
 
 from .mask_utils import BAND_INF
+from ..utils.profiling import instrument_host
 
 # meta columns per work item
 QS, QE, KS, KE, DLO, DHI, IS_FIRST, IS_LAST, IS_FULL = range(9)
@@ -66,6 +67,7 @@ def _band_tile_interaction(
     return nonempty, full
 
 
+@instrument_host
 def build_ffa_plan(
     q_ranges: np.ndarray,
     k_ranges: np.ndarray,
@@ -76,7 +78,24 @@ def build_ffa_plan(
     block_q: int,
     block_k: int,
 ) -> FFAPlan:
-    """Build the work-item lists for the given band-slice metadata."""
+    """Build the work-item lists for the given band-slice metadata.
+
+    When ``MAGI_ATTENTION_RANGE_MERGE`` is on (default), band-compatible
+    adjacent slices are merged first (mask_utils.merge_band_slices — the ref
+    merges at its kernel entry, functional/flex_flash_attn.py:87). Exact:
+    bands are global-coordinate, so the merged cover is identical; fragmented
+    masks (block-sparse, video) collapse into fewer work items. This is the
+    one choke point every planning path flows through (single-device
+    ffa_attn, CP _stack_plans, dynamic runtime), so all of them benefit.
+    """
+    from ..env.general import is_range_merge_enable
+
+    if is_range_merge_enable():
+        from .mask_utils import merge_band_slices
+
+        q_ranges, k_ranges, d_lo, d_hi = merge_band_slices(
+            q_ranges, k_ranges, d_lo, d_hi
+        )
     num_q_tiles = max(1, -(-seqlen_q // block_q))
     num_k_tiles = max(1, -(-seqlen_k // block_k))
 
@@ -236,6 +255,7 @@ def _cached_plan(
     seqlen_k: int,
     block_q: int,
     block_k: int,
+    range_merge: bool,  # cache-key only: build reads the env flag itself
 ) -> FFAPlan:
     qr = np.frombuffer(qr_bytes, dtype=np.int32).reshape(n, 2)
     kr = np.frombuffer(kr_bytes, dtype=np.int32).reshape(n, 2)
@@ -259,7 +279,9 @@ def get_ffa_plan(
     kr = np.ascontiguousarray(k_ranges, dtype=np.int32)
     lo = np.ascontiguousarray(d_lo, dtype=np.int32)
     hi = np.ascontiguousarray(d_hi, dtype=np.int32)
+    from ..env.general import is_range_merge_enable
+
     return _cached_plan(
         qr.tobytes(), kr.tobytes(), lo.tobytes(), hi.tobytes(), len(qr),
-        seqlen_q, seqlen_k, block_q, block_k,
+        seqlen_q, seqlen_k, block_q, block_k, is_range_merge_enable(),
     )

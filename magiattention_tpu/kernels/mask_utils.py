@@ -50,6 +50,87 @@ def types_to_bands(q_ranges, k_ranges, attn_type_map):
     return d_lo, d_hi
 
 
+def merge_band_slices(
+    q_ranges,
+    k_ranges,
+    d_lo,
+    d_hi,
+):
+    """Merge band-compatible adjacent slices (host numpy, exact).
+
+    The TPU counterpart of the reference's kernel-entry range merge
+    (magi_attention/functional/flex_flash_attn.py:87 merge_ranges, backed by
+    csrc/extensions/unique_consecutive_pairs.cu). Because bands are encoded
+    in GLOBAL coordinates (``d_lo <= j - i <= d_hi`` — see
+    :func:`types_to_bands`), two rectangles with the SAME band whose k
+    ranges are adjacent (or whose q ranges are adjacent, at equal k) union
+    to one rectangle with that band — the merged slice covers exactly the
+    same (i, j) pairs, so the kernel's output is mathematically identical
+    while fragmented masks (e.g. per-block ranges from block-sparse /
+    video masks) collapse into far fewer work items.
+
+    Empty slices (``q_start >= q_end`` or ``k_start >= k_end``) are dropped
+    (they are padding by contract). Returns ``(q_ranges, k_ranges, d_lo,
+    d_hi)`` int32 arrays with at least one row: if every input slice was
+    empty (or the input had zero rows), a single all-zero empty slice is
+    synthesized so downstream plan builders never index into nothing.
+    """
+    import numpy as np
+
+    qr = np.asarray(q_ranges, dtype=np.int64).reshape(-1, 2)
+    kr = np.asarray(k_ranges, dtype=np.int64).reshape(-1, 2)
+    lo = np.asarray(d_lo, dtype=np.int64).reshape(-1)
+    hi = np.asarray(d_hi, dtype=np.int64).reshape(-1)
+
+    keep = (qr[:, 0] < qr[:, 1]) & (kr[:, 0] < kr[:, 1])
+    if not keep.any():
+        empty = np.zeros((1, 2), np.int32)
+        return (
+            empty, empty.copy(),
+            np.zeros(1, np.int32), np.zeros(1, np.int32),
+        )
+    rows = np.concatenate(
+        [qr[keep], kr[keep], lo[keep, None], hi[keep, None]], axis=1
+    )  # (n, 6): q0 q1 k0 k1 lo hi
+
+    def sweep(rows, key_cols, adj_lo, adj_hi):
+        """Sort by key_cols then merge maximal chains where all key_cols
+        match and each row's [adj_lo] equals its predecessor's [adj_hi];
+        the merged row spans [first.adj_lo, last.adj_hi). Fully vectorized
+        — this sits in front of the native plan builder on fragmented
+        masks with tens of thousands of slices, so no Python row loop."""
+        order = np.lexsort(
+            tuple(rows[:, c] for c in reversed(key_cols + [adj_lo]))
+        )
+        r = rows[order]
+        n = len(r)
+        start = np.ones(n, dtype=bool)
+        if n > 1:
+            same_key = np.ones(n - 1, dtype=bool)
+            for c in key_cols:
+                same_key &= r[1:, c] == r[:-1, c]
+            start[1:] = ~(same_key & (r[1:, adj_lo] == r[:-1, adj_hi]))
+        out = r[start].copy()
+        starts = np.nonzero(start)[0]
+        last = np.append(starts[1:] - 1, n - 1)
+        out[:, adj_hi] = r[last, adj_hi]
+        return out
+
+    prev_n = -1
+    while rows.shape[0] != prev_n:
+        prev_n = rows.shape[0]
+        # k-direction: same (q range, band), k-adjacent
+        rows = sweep(rows, [0, 1, 4, 5], adj_lo=2, adj_hi=3)
+        # q-direction: same (k range, band), q-adjacent
+        rows = sweep(rows, [2, 3, 4, 5], adj_lo=0, adj_hi=1)
+    return (
+        rows[:, 0:2].astype(np.int32),
+        rows[:, 2:4].astype(np.int32),
+        rows[:, 4].astype(np.int32),
+        rows[:, 5].astype(np.int32),
+    )
+
+
 def slice_block_mask_band(
     q_start, q_end, k_start, k_end, d_lo, d_hi, q_index, k_index
 ):

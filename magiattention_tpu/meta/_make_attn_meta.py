@@ -14,8 +14,10 @@ from .collection.dispatch_meta import DispatchMeta
 from .collection.dynamic_meta import DynamicAttnPlan
 from .container.bucket import AttnBucket
 from .solver.dist_attn_solver import DistAttnSolver
+from ..utils.profiling import instrument_host
 
 
+@instrument_host
 def make_attn_meta_from_dispatch_meta(
     bucket: AttnBucket,
     dispatch_meta: DispatchMeta,

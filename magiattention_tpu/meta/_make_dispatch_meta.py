@@ -17,6 +17,7 @@ from .collection.dispatch_meta import DispatchMeta
 from .container.bucket import AttnBucket, AttnChunk
 from .container.slice import AttnSlice
 from .solver.dispatch_solver import DispatchConfig, DispatchSolver
+from ..utils.profiling import instrument_host
 
 _logger = logging.getLogger("magiattention_tpu.dispatch")
 
@@ -212,6 +213,7 @@ def _auto_select_partitions(
     return best[2], best[3]
 
 
+@instrument_host
 def make_dispatch_meta_from_qk_ranges(
     q_ranges: AttnRanges,
     k_ranges: AttnRanges,

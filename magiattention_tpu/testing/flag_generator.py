@@ -20,7 +20,6 @@ FLAG_SPACE: dict[str, list[str | None]] = {
     "MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE": [None, "0", "1"],
     "MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE": [None, "0", "1"],
     "MAGI_ATTENTION_CPP_BACKEND": [None, "0", "1"],
-    "MAGI_ATTENTION_DETERMINISTIC_MODE": [None, "0", "1"],
     "MAGI_ATTENTION_NATIVE_FFA_PLAN": [None, "0", "1"],
     "MAGI_ATTENTION_FFA_GQA_PACK": [None, "0", "1"],
     "MAGI_ATTENTION_FFA_GQA_PACK_DQ": [None, "0", "1"],
@@ -32,8 +31,8 @@ HEURISTIC_COMBOS: list[dict[str, str]] = [
      "MAGI_ATTENTION_CPP_BACKEND": "0"},
     {"MAGI_ATTENTION_KERNEL_BACKEND": "ffa",
      "MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE": "0"},
-    {"MAGI_ATTENTION_KERNEL_BACKEND": "sdpa_online",
-     "MAGI_ATTENTION_DETERMINISTIC_MODE": "1"},
+    {"MAGI_ATTENTION_KERNEL_BACKEND": "ffa",
+     "MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE": "1"},
     {"MAGI_ATTENTION_KERNEL_BACKEND": "ffa",
      "MAGI_ATTENTION_NATIVE_FFA_PLAN": "0"},
     # both GQA packs + auto-tile through the full pipeline at once

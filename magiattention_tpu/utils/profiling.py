@@ -13,18 +13,61 @@ from typing import Callable
 
 import jax
 
+from ..env import general as env_general
+
 
 def instrument_scope(fn: Callable | None = None, *, name: str | None = None):
     """Decorator wrapping a function in a ``jax.named_scope`` (the
     ``instrument_nvtx`` equivalent, ref nvtx.py:81). Scope names appear in
-    HLO metadata and profiler traces."""
+    HLO metadata and profiler traces.
+
+    Gated on ``MAGI_ATTENTION_PROFILE_MODE`` (read per call, i.e. per
+    trace): off by default, zero overhead in production programs — the
+    reference gates its nvtx instrumentation the same way
+    (env/general.py:191)."""
 
     def wrap(f):
         scope = name or f.__qualname__
 
         @functools.wraps(f)
         def inner(*args, **kwargs):
+            if not env_general.is_profile_mode_enable():
+                return f(*args, **kwargs)
             with jax.named_scope(scope):
+                return f(*args, **kwargs)
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+@contextmanager
+def profile_scope(name: str):
+    """Inline ``jax.named_scope`` gated on MAGI_ATTENTION_PROFILE_MODE —
+    for loop bodies (per-stage kernels / casts) where a decorator can't
+    reach."""
+    if not env_general.is_profile_mode_enable():
+        yield
+    else:
+        with jax.named_scope(name):
+            yield
+
+
+def instrument_host(fn: Callable | None = None, *, name: str | None = None):
+    """Host-side profiler annotation (``jax.profiler.TraceAnnotation``) for
+    UN-traced hot paths — solvers, plan builders, runtime init. These run in
+    Python, so named_scope (an HLO-metadata construct) cannot see them; the
+    TraceAnnotation puts them on the profiler timeline instead (the ref
+    add_nvtx_event analogue). Gated on MAGI_ATTENTION_PROFILE_MODE."""
+
+    def wrap(f):
+        scope = name or f.__qualname__
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            if not env_general.is_profile_mode_enable():
+                return f(*args, **kwargs)
+            with jax.profiler.TraceAnnotation(scope):
                 return f(*args, **kwargs)
 
         return inner

@@ -1,0 +1,105 @@
+"""Kernel-entry range merge (ref merge_ranges,
+magi_attention/functional/flex_flash_attn.py:87 + unique_consecutive_pairs.cu;
+here mask_utils.merge_band_slices wired into build_ffa_plan behind
+MAGI_ATTENTION_RANGE_MERGE)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from magiattention_tpu.kernels.ffa_plan import build_ffa_plan
+from magiattention_tpu.kernels.mask_utils import (
+    BAND_INF,
+    build_dense_mask_band,
+    merge_band_slices,
+    types_to_bands,
+)
+
+
+def _fragmented_block_mask(nq=4, nk=4, block=64):
+    """Per-block FULL slices of a dense region + a causal tail — the shape
+    block-sparse / video masks produce."""
+    qr, kr, tm = [], [], []
+    for i in range(nq):
+        for j in range(nk):
+            qr.append([i * block, (i + 1) * block])
+            kr.append([j * block, (j + 1) * block])
+            tm.append(0)
+    qr.append([nq * block, nq * block + 128])
+    kr.append([0, nq * block + 128])
+    tm.append(1)
+    return (
+        np.asarray(qr, np.int32), np.asarray(kr, np.int32),
+        np.asarray(tm, np.int32),
+    )
+
+
+def test_merge_preserves_mask_exactly():
+    qr, kr, tm = _fragmented_block_mask()
+    lo, hi = types_to_bands(qr, kr, tm)
+    mq, mk, mlo, mhi = merge_band_slices(qr, kr, lo, hi)
+    sq = sk = int(max(qr[:, 1].max(), kr[:, 1].max()))
+    dense_orig = np.asarray(build_dense_mask_band(
+        jnp.asarray(qr), jnp.asarray(kr), jnp.asarray(lo), jnp.asarray(hi),
+        sq, sk,
+    ))
+    dense_merged = np.asarray(build_dense_mask_band(
+        jnp.asarray(mq), jnp.asarray(mk), jnp.asarray(mlo), jnp.asarray(mhi),
+        sq, sk,
+    ))
+    np.testing.assert_array_equal(dense_orig, dense_merged)
+    # the 4x4 block grid collapses to one slice; the causal tail stays
+    assert len(mq) == 2
+
+
+def test_merge_keeps_distinct_bands_apart():
+    # same rectangle adjacency but different bands must NOT merge
+    qr = np.array([[0, 64], [0, 64]], np.int32)
+    kr = np.array([[0, 64], [64, 128]], np.int32)
+    lo = np.array([-BAND_INF, -BAND_INF], np.int32)
+    hi = np.array([BAND_INF, 0], np.int32)  # second is causal-bounded
+    mq, mk, mlo, mhi = merge_band_slices(qr, kr, lo, hi)
+    assert len(mq) == 2
+
+
+def test_merge_drops_empty_slices():
+    qr = np.array([[0, 0], [10, 5], [0, 64]], np.int32)
+    kr = np.array([[0, 64], [0, 64], [0, 64]], np.int32)
+    lo = np.full(3, -BAND_INF, np.int32)
+    hi = np.full(3, BAND_INF, np.int32)
+    mq, _, _, _ = merge_band_slices(qr, kr, lo, hi)
+    assert len(mq) == 1 and mq[0].tolist() == [0, 64]
+
+
+def test_plan_shrinks_and_flag_disables(monkeypatch):
+    qr, kr, tm = _fragmented_block_mask()
+    lo, hi = types_to_bands(qr, kr, tm)
+    sq = sk = int(max(qr[:, 1].max(), kr[:, 1].max()))
+
+    monkeypatch.setenv("MAGI_ATTENTION_RANGE_MERGE", "1")
+    p_on = build_ffa_plan(qr, kr, lo, hi, sq, sk, 128, 128)
+    monkeypatch.setenv("MAGI_ATTENTION_RANGE_MERGE", "0")
+    p_off = build_ffa_plan(qr, kr, lo, hi, sq, sk, 128, 128)
+    assert p_on.num_work < p_off.num_work
+
+
+def test_kernel_output_unchanged_by_merge(monkeypatch):
+    from magiattention_tpu.kernels.ffa import ffa_attn
+
+    qr, kr, tm = _fragmented_block_mask()
+    sq = sk = int(max(qr[:, 1].max(), kr[:, 1].max()))
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((sq, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((sk, 1, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((sk, 1, 32)), jnp.float32)
+
+    monkeypatch.setenv("MAGI_ATTENTION_RANGE_MERGE", "0")
+    o_off, lse_off = ffa_attn(q, k, v, qr, kr, tm)
+    monkeypatch.setenv("MAGI_ATTENTION_RANGE_MERGE", "1")
+    o_on, lse_on = ffa_attn(q, k, v, qr, kr, tm)
+    np.testing.assert_allclose(
+        np.asarray(o_on), np.asarray(o_off), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse_on), np.asarray(lse_off), rtol=2e-5, atol=2e-5
+    )
