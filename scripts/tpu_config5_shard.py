@@ -1,0 +1,184 @@
+"""Silicon slice of BASELINE config 5 (Llama-3-8B CP=32, seq=1M, fwd+bwd).
+
+Multi-chip hardware is unavailable here, but the per-rank program of the
+1M-token cp=32 plan — a 32k q-shard attending its host+remote kv rows — is a
+single-chip kernel. This script builds the REAL plan (same solver path the
+sanity-checked 1M test uses, tests/test_support/test_scale_numeric.py), picks
+the maximum-area rank, and runs its merged FFA program fwd+bwd on silicon
+with slope timing, recording TFLOP/s against the rank's true band area —
+the kernel-side half of the north-star claim (BASELINE.md config 5).
+
+HBM guard: the full kv buffer of a 1M causal rank shard may not fit one
+chip once the fp32 dkv outputs and head-major transposes are counted. If
+the estimate exceeds the budget, the kv buffer is clipped to its largest
+prefix that fits (band encoding keeps clipped slices exact) and the row
+records the covered fraction — rate is the metric, not total time.
+
+Appends to benchmarks/history/config5_shard.csv.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("MAGI_FORCE_CPU") == "1":
+    # the axon sitecustomize force-sets JAX_PLATFORMS=axon; only
+    # jax.config reliably pins CPU for plan-only validation runs
+    jax.config.update("jax_platforms", "cpu")
+
+try:
+    from magiattention_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+except Exception:
+    pass
+import jax.numpy as jnp
+import numpy as np
+
+from magiattention_tpu.benchmarking.bench import (  # noqa: E402
+    do_bench_scan_slope,
+    make_consume_all_grads_body,
+)
+from magiattention_tpu.benchmarking.perf_report import append_row  # noqa: E402
+
+SP = int(os.environ.get("MAGI_CONFIG5_SP", 1 << 20))
+CPN = int(os.environ.get("MAGI_CONFIG5_CP", 32))
+HQ, HK, D = 32, 8, 128  # Llama-3-8B attention geometry
+PEAK = 197.0
+HBM_BUDGET = 11 * 2**30  # leave headroom out of 16 GB for XLA scratch
+
+
+def band_area(qr, kr, lo, hi) -> int:
+    """Exact unmasked area of band slices (vectorized per slice)."""
+    total = 0
+    for (q0, q1), (k0, k1), lo_s, hi_s in zip(qr, kr, lo, hi):
+        if q0 >= q1 or k0 >= k1:
+            continue
+        i = np.arange(q0, q1, dtype=np.int64)
+        j_lo = np.maximum(k0, i + lo_s)
+        j_hi = np.minimum(k1 - 1, i + hi_s)
+        total += int(np.maximum(0, j_hi - j_lo + 1).sum())
+    return total
+
+
+def main() -> int:
+    print("backend:", jax.default_backend(), flush=True)
+
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.kernels.ffa import (
+        FFAParams, _should_interpret, default_blocks, ffa_attn_with_plan,
+        plan_arrays,
+    )
+    from magiattention_tpu.kernels.ffa_plan import get_ffa_plan
+    from magiattention_tpu.meta import (
+        make_attn_meta_from_dispatch_meta,
+        make_dispatch_meta_from_qk_ranges,
+    )
+
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges([[0, SP]]),
+        AttnRanges.from_ranges([[0, SP]]),
+        [AttnMaskType.CAUSAL], SP, SP, SP // 512, CPN,
+    )
+    cmm, calc = make_attn_meta_from_dispatch_meta(bucket, mq)
+    sq = calc.shard_len
+    sk_full = calc.kv_shard_len + sum(calc.recv_len_per_stage)
+
+    # pick the max-area rank: its program is the makespan of the real run
+    areas = []
+    for a in calc.merged_args:
+        areas.append(band_area(a.q_ranges, a.k_ranges, a.d_lo, a.d_hi))
+    r = int(np.argmax(areas))
+    a = calc.merged_args[r]
+    print(f"rank {r}: sq={sq} sk={sk_full} area={areas[r]:.3e} "
+          f"(min-rank area {min(areas):.3e})", flush=True)
+
+    # HBM estimate: q/do/out bf16 + k/v bf16 (+head-major copies) + fp32
+    # dq/dk/dv outputs + lse/delta
+    def mem_bytes(sk):
+        q_side = sq * HQ * D * 2 * 4        # q, do, out, dq(fp32 ~ 2x bf16)
+        kv_side = sk * HK * D * 2 * 2 * 2   # k, v + head-major copies
+        dkv = sk * HK * D * 4 * 2           # fp32 dk + dv
+        return q_side + kv_side + dkv
+
+    sk = sk_full
+    qr_np = np.asarray(a.q_ranges, np.int32)
+    kr_np = np.asarray(a.k_ranges, np.int32)
+    lo_np = np.asarray(a.d_lo, np.int32)
+    hi_np = np.asarray(a.d_hi, np.int32)
+    frac = 1.0
+    if mem_bytes(sk_full) > HBM_BUDGET:
+        # clip kv to the largest prefix that fits; bands stay exact
+        sk = sk_full
+        while mem_bytes(sk) > HBM_BUDGET:
+            sk = int(sk * 0.85) // 128 * 128
+        keep = kr_np[:, 0] < sk
+        qr_np, lo_np, hi_np = qr_np[keep], lo_np[keep], hi_np[keep]
+        kr_np = np.minimum(kr_np[keep], sk)
+        area_cov = band_area(qr_np, kr_np, lo_np, hi_np)
+        frac = area_cov / areas[r]
+        print(f"HBM clip: sk {sk_full} -> {sk} (area coverage {frac:.2%})",
+              flush=True)
+
+    area = band_area(qr_np, kr_np, lo_np, hi_np)
+    if "--plan-only" in sys.argv:
+        print(f"plan-only: area={area:.3e} slices={len(qr_np)} ok",
+              flush=True)
+        return 0
+    bq, bk = default_blocks(sq, sk)
+    plan = get_ffa_plan(qr_np, kr_np, lo_np, hi_np, sq, sk, bq, bk)
+    params = FFAParams(
+        num_work=plan.num_work, num_work_t=plan.num_work_t,
+        num_q_tiles=plan.num_q_tiles, num_k_tiles=plan.num_k_tiles,
+        block_q=bq, block_k=bk, softmax_scale=float(D) ** -0.5,
+        softcap=0.0, group=HQ // HK, interpret=_should_interpret(),
+    )
+    arrays = tuple(jnp.asarray(x) for x in plan_arrays(plan))
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((sq, HQ, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((sk, HK, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((sk, HK, D)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((sq, HQ, D)), jnp.bfloat16)
+
+    fwd_flops = 4 * area * D * HQ
+
+    def fwd(qc):
+        o, _ = ffa_attn_with_plan(qc, k, v, arrays, params)
+        return o.astype(jnp.bfloat16)
+
+    ms = do_bench_scan_slope(fwd, q, lengths=(4, 12))
+    tf_fwd = fwd_flops / (ms * 1e-3) / 1e12
+    print(f"config5 rank-shard fwd: {ms:.1f} ms {tf_fwd:.1f} TF/s "
+          f"({tf_fwd/PEAK*100:.1f}% nominal)", flush=True)
+    append_row("config5_shard", {
+        "phase": "fwd", "rank": r, "sq": sq, "sk": sk,
+        "area_frac": round(frac, 4), "ms": round(ms, 2),
+        "tflops": round(tf_fwd, 2),
+        "pct_nominal": round(tf_fwd / PEAK * 100, 1),
+    })
+
+    def loss(qc, kc, vc):
+        o, _ = ffa_attn_with_plan(qc, kc, vc, arrays, params)
+        return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+    step = make_consume_all_grads_body(lambda qc: g(qc, k, v), jnp.bfloat16)
+    msb = do_bench_scan_slope(step, q, lengths=(3, 9))
+    tf = fwd_flops * 3.5 / (msb * 1e-3) / 1e12
+    print(f"config5 rank-shard fwd+bwd: {msb:.1f} ms {tf:.1f} TF/s "
+          f"({tf/PEAK*100:.1f}% nominal)", flush=True)
+    append_row("config5_shard", {
+        "phase": "fwdbwd", "rank": r, "sq": sq, "sk": sk,
+        "area_frac": round(frac, 4), "ms": round(msb, 2),
+        "tflops": round(tf, 2),
+        "pct_nominal": round(tf / PEAK * 100, 1),
+    })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,10 @@ import json
 import os
 import sys
 
+# hot-path named scopes (utils/profiling.py) must be on BEFORE the
+# package traces anything, so phase attribution shows up in the events
+os.environ["MAGI_ATTENTION_PROFILE_MODE"] = "1"
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
@@ -30,7 +34,7 @@ def main() -> int:
 
     from magiattention_tpu.kernels.ffa import ffa_attn
 
-    S, HQ, HK, D = 4096, 16, 8, 128
+    S, HQ, HK, D = 8192, 16, 8, 128
     rng = np.random.default_rng(0)
     q0 = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.bfloat16)
@@ -38,11 +42,20 @@ def main() -> int:
     qr = np.array([[0, S]], np.int32)
     tm = np.array([1], np.int32)
 
+    w = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.bfloat16)
+
+    def loss(q):
+        o, _lse = ffa_attn(q, k, v, qr, qr, tm, block_q=512, block_k=512)
+        return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+
+    grad = jax.grad(loss)
+
     @jax.jit
     def run(q):
+        # fwd+bwd chained: the trace must attribute BOTH directions (the
+        # headline metric is fwd+bwd; r3 judged the gap is not bwd-only)
         def body(c, _):
-            o, _lse = ffa_attn(c, k, v, qr, qr, tm, block_q=512, block_k=512)
-            return o.astype(jnp.bfloat16), None
+            return grad(c).astype(jnp.bfloat16), None
 
         return jax.lax.scan(body, q, None, length=4)[0]
 
@@ -70,7 +83,7 @@ def main() -> int:
         if e.get("ph") == "X" and "TPU" in pid_names.get(e.get("pid"), ""):
             durs[e["name"]] = durs.get(e["name"], 0.0) + e.get("dur", 0.0)
     total = sum(durs.values())
-    print(f"total device time: {total/1e3:.2f} ms (4 chained fwd)")
+    print(f"total device time: {total/1e3:.2f} ms (4 chained fwd+bwd)")
     for name, d in sorted(durs.items(), key=lambda kv: -kv[1])[:15]:
         print(f"  {d/1e3:9.3f} ms  {d/max(total,1)*100:5.1f}%  {name[:90]}")
     return 0

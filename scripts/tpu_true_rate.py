@@ -92,7 +92,8 @@ def main():
     # -- 2. FFA on the bench shape (slope), headline tiling first --------
     from magiattention_tpu.kernels.ffa import ffa_attn
 
-    S, HQ, HK, D = 4096, 16, 8, 128
+    S, HQ, HK, D = 8192, 16, 8, 128
+    ATT_LENGTHS = (8, 32)  # per-step ~4x the 4096 cost; slope still cancels
     area = S * (S + 1) // 2
     fwd_flops = 4 * area * D * HQ
     qs = jnp.asarray(rng.standard_normal((S, HQ, D)), jnp.bfloat16)
@@ -117,13 +118,13 @@ def main():
             return jnp.sum(o.astype(jnp.float32) * ws.astype(jnp.float32))
 
         try:
-            ms = do_bench_scan_slope(ffa_fwd, qs, lengths=LENGTHS, verbose=True)
+            ms = do_bench_scan_slope(ffa_fwd, qs, lengths=ATT_LENGTHS, verbose=True)
             record(f"ffa_fwd_bq{bq}_bk{bk}", ms, fwd_flops)
             g = jax.grad(ffa_loss, argnums=(0, 1, 2))
             step = make_consume_all_grads_body(
                 lambda q: g(q, ks, vs), jnp.bfloat16
             )
-            msb = do_bench_scan_slope(step, qs, lengths=LENGTHS, verbose=True)
+            msb = do_bench_scan_slope(step, qs, lengths=ATT_LENGTHS, verbose=True)
             record(f"ffa_fwdbwd_bq{bq}_bk{bk}", msb, fwd_flops * 3.5)
             record(f"ffa_fwdbwd_hw_bq{bq}_bk{bk}", msb,
                    fwd_flops * 3.5 * HW_FWD_BWD_RATIO)
@@ -146,7 +147,7 @@ def main():
         )[0].astype(jnp.bfloat16)
 
     try:
-        ms = do_bench_scan_slope(ffa_fwd_eq, qs, lengths=LENGTHS, verbose=True)
+        ms = do_bench_scan_slope(ffa_fwd_eq, qs, lengths=ATT_LENGTHS, verbose=True)
         record("ffa_fwd_eqheads_bq512_bk512", ms, ab_flops)
     except Exception as e:
         print(f"ffa eqheads: FAIL {type(e).__name__}: {str(e)[:200]}",
@@ -174,18 +175,53 @@ def main():
             return jnp.sum(o.astype(jnp.float32) * wb.astype(jnp.float32))
 
         try:
-            ms = do_bench_scan_slope(bundled_fwd, qb, lengths=LENGTHS,
+            ms = do_bench_scan_slope(bundled_fwd, qb, lengths=ATT_LENGTHS,
                                      verbose=True)
             record("bundled_fwd", ms, ab_flops)
             g = jax.grad(bundled_loss, argnums=(0, 1, 2))
             step = make_consume_all_grads_body(
                 lambda q: g(q, kb, vb), jnp.bfloat16
             )
-            msb = do_bench_scan_slope(step, qb, lengths=LENGTHS, verbose=True)
+            msb = do_bench_scan_slope(step, qb, lengths=ATT_LENGTHS, verbose=True)
             record("bundled_fwdbwd", msb, ab_flops * 3.5)
         except Exception as e:
             print(f"bundled: FAIL {type(e).__name__}: {str(e)[:200]}",
                   flush=True)
+
+    # -- 3b. splash_attention bar (the production TPU kernel, equal heads)
+    try:
+        from jax.experimental.pallas.ops.tpu import splash_attention as _sp
+
+        sp_mask = _sp.MultiHeadMask(
+            [_sp.CausalMask((S, S)) for _ in range(H)]
+        )
+        sp_kernel = _sp.splash_attention_kernel.make_splash_mha_single_device(
+            sp_mask
+        )
+        qsp = jnp.asarray(rng.standard_normal((H, S, D)), jnp.bfloat16)
+        ksp = jnp.asarray(rng.standard_normal((H, S, D)), jnp.bfloat16)
+        vsp = jnp.asarray(rng.standard_normal((H, S, D)), jnp.bfloat16)
+        wsp = jnp.asarray(rng.standard_normal((H, S, D)), jnp.bfloat16)
+
+        def splash_fwd(q):
+            return sp_kernel(q, ksp, vsp).astype(jnp.bfloat16)
+
+        def splash_loss(q, k, v):
+            o = sp_kernel(q, k, v)
+            return jnp.sum(o.astype(jnp.float32) * wsp.astype(jnp.float32))
+
+        ms = do_bench_scan_slope(splash_fwd, qsp, lengths=ATT_LENGTHS,
+                                 verbose=True)
+        record("splash_fwd", ms, ab_flops)
+        g = jax.grad(splash_loss, argnums=(0, 1, 2))
+        step = make_consume_all_grads_body(
+            lambda q: g(q, ksp, vsp), jnp.bfloat16
+        )
+        msb = do_bench_scan_slope(step, qsp, lengths=ATT_LENGTHS,
+                                  verbose=True)
+        record("splash_fwdbwd", msb, ab_flops * 3.5)
+    except Exception as e:
+        print(f"splash: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
 
     # -- 4. sweep extras (only reached when the window survived the
     # decisive set): alternative tilings, GQA-packed fwd, mm8192 ---------
@@ -207,7 +243,7 @@ def main():
 
             try:
                 ms = do_bench_scan_slope(
-                    ffa_fwd_p, qs, lengths=LENGTHS, verbose=True
+                    ffa_fwd_p, qs, lengths=ATT_LENGTHS, verbose=True
                 )
                 record(f"ffa_fwd_gqapack_bq{bq}_bk{bk}", ms, fwd_flops)
             except Exception as e:
@@ -231,7 +267,7 @@ def main():
             step = make_consume_all_grads_body(
                 lambda q: g(q, ks, vs), jnp.bfloat16
             )
-            msb = do_bench_scan_slope(step, qs, lengths=LENGTHS, verbose=True)
+            msb = do_bench_scan_slope(step, qs, lengths=ATT_LENGTHS, verbose=True)
             record("ffa_fwdbwd_gqapackdq_bq512_bk512", msb, fwd_flops * 3.5)
         except Exception as e:
             print(f"gqapack_dq: FAIL {type(e).__name__}: {str(e)[:200]}",

@@ -78,6 +78,9 @@ run_queue() {
     run_step 900 ".tpu_logs/${TS}_smoke.log" python -u scripts/tpu_smoke.py || return
     grep -q "^SMOKE PASS" ".tpu_logs/${TS}_smoke.log" && touch "$SMOKE_STAMP"
   fi
+  # BASELINE config 5 rank-shard: the kernel-side half of the 1M cp=32
+  # north-star claim — early in the queue, it is this round's new evidence
+  run_step 2400 ".tpu_logs/${TS}_config5.log" python -u scripts/tpu_config5_shard.py || return
   run_step 2400 ".tpu_logs/${TS}_probe.log" python -u scripts/tpu_perf_probe.py || return
   run_step 2400 ".tpu_logs/${TS}_grid.log" python -u benchmarks/kernel_bench.py \
     --seqlens 4096,8192,32768 --backward || return
