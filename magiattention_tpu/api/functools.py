@@ -107,12 +107,12 @@ def infer_attn_mask_from_sliding_window(
     for qr, kr, mt in zip(q_ranges, k_ranges, attn_mask_type):
         if (qr.start, qr.end) != (kr.start, kr.end):
             raise ValueError("sliding window needs self-attending segments")
+        if mt not in (AttnMaskType.CAUSAL, AttnMaskType.FULL):
+            raise NotImplementedError(
+                f"sliding windows over {mt} segments are not compiled"
+            )
         s, e = qr.start, qr.end
         causal = mt == AttnMaskType.CAUSAL or right == 0
-        if not causal:
-            raise NotImplementedError(
-                "only causal sliding windows are compiled for now"
-            )
         lw = left if left >= 0 else e - s
         # Disjoint decomposition (overlapping slices would double-count in
         # the kernel's softmax): sink-region rows attend plain-causally;
@@ -123,13 +123,37 @@ def infer_attn_mask_from_sliding_window(
             emit(s, s + snk, s, s + snk, AttnMaskType.CAUSAL)
             emit(s + snk, e, s, s + snk, AttnMaskType.FULL)
         w0 = s + snk  # first non-sink column / row
-        # rows r >= w0 see cols [max(r-lw, w0), r] beyond the sink: head
-        # part is plain causal, tail is a bicausal band
-        hsplit = min(w0 + lw + 1, e)
-        emit(w0, hsplit, w0, hsplit, AttnMaskType.CAUSAL)
-        # BICAUSAL band: lo = ks - qs = -lw  => ks = qs - lw
-        #                hi = ke - qe = 0    => ke = qe
-        emit(hsplit, e, hsplit - lw, e, AttnMaskType.BICAUSAL)
+        if causal:
+            # rows r >= w0 see cols [max(r-lw, w0), r] beyond the sink: head
+            # part is plain causal, tail is a bicausal band
+            hsplit = min(w0 + lw + 1, e)
+            emit(w0, hsplit, w0, hsplit, AttnMaskType.CAUSAL)
+            # BICAUSAL band: lo = ks - qs = -lw  => ks = qs - lw
+            #                hi = ke - qe = 0    => ke = qe
+            emit(hsplit, e, hsplit - lw, e, AttnMaskType.BICAUSAL)
+            continue
+        # General (left, right) window over a FULL segment (ref
+        # functools.py:180): row r sees cols [max(w0, r-lw), min(e-1, r+rw)].
+        # Split rows by which window edge is clipped by the segment so each
+        # region's band is EXACTLY reproduced by one mask type (the four
+        # types bound the band at range corners — types_to_bands):
+        #   [w0, a): left edge clipped at w0        -> CAUSAL  (hi = rw)
+        #   [a, b):  interior                       -> BICAUSAL(-lw, rw)
+        #   [b, e):  right edge clipped at e        -> INVCAUSAL (lo = -lw)
+        # When a > b (narrow segment: lw+rw >= e-w0), the middle rows have
+        # BOTH edges clipped -> FULL over [w0, e).
+        rw = right if right >= 0 else e - s
+        a = min(w0 + lw + 1, e)  # first row with unclipped left edge
+        b = max(e - rw, w0)      # first row with clipped right edge
+        m1, m2 = min(a, b), max(a, b)
+        emit(w0, m1, w0, min(m1 + rw, e), AttnMaskType.CAUSAL)
+        if a < b:
+            emit(m1, m2, m1 - lw, m2 + rw, AttnMaskType.BICAUSAL)
+        else:
+            emit(m1, m2, w0, e, AttnMaskType.FULL)
+        # m2 - lw > w0 whenever this region is non-empty (m2 >= w0+lw+1),
+        # so the INVCAUSAL lo bound is exactly -lw — no clip needed
+        emit(m2, e, m2 - lw, e, AttnMaskType.INVCAUSAL)
     return out_q, out_k, out_t
 
 

@@ -39,23 +39,23 @@ def _band(
 
 
 def _check_sink(sink, sink_layout: AttnSinkLayout):
+    """Delegates to the one layout rule set (functional/sink.py)."""
     if sink is None:
         return None
-    if sink_layout != "sh":
-        raise NotImplementedError(
-            f"sink_layout={sink_layout!r}: only the shared 'sh' "
-            f"(seqlen_sink, nheads) layout is implemented on TPU"
-        )
+    from ..functional.sink import check_sink_layout
+
+    check_sink_layout(sink_layout)
     return sink
 
 
 def _run_packed(
-    q, k, v, qr, kr, d_lo, d_hi, sink, softmax_scale, softcap, backend
+    q, k, v, qr, kr, d_lo, d_hi, sink, softmax_scale, softcap, backend,
+    sink_layout: AttnSinkLayout = "sh",
 ):
     out, meta = flex_flash_attn_func(
         q, k, v, qr, kr, None,
         softmax_scale=softmax_scale, softcap=softcap, sink=sink,
-        backend=backend,
+        sink_layout=sink_layout, backend=backend,
         d_lo=np.asarray(d_lo, np.int32), d_hi=np.asarray(d_hi, np.int32),
     )
     return out, meta.lse
@@ -84,7 +84,10 @@ def fa3_func_with_sink(
 
     Args:
         q/k/v: ``(b, s, h, d)`` / ``(b, sk, hk, d)``.
-        sink: ``(s_sink, h)`` shared sink logits (layout "sh").
+        sink: ``(s_sink, h)`` shared sink logits (layout "sh"), or
+            ``(b, s, s_sink, h)`` per-row logits (layout "ssh" — packed to
+            ``(b*s, s_sink, h)`` exactly as the reference's rearrange,
+            fa3_interface_with_sink.py:350).
 
     Returns:
         out ``(b, s, h, d)``; with ``return_attn_probs``, also lse
@@ -93,6 +96,8 @@ def fa3_func_with_sink(
     sink = _check_sink(sink, sink_layout)
     b, sq, hq, dh = q.shape
     _, sk, hk, dv = v.shape
+    if sink is not None and sink_layout == "ssh":
+        sink = sink.reshape(b * sq, *sink.shape[2:])
     d_lo, d_hi = _band(sq, sk, causal, window_size)
 
     qp = q.reshape(b * sq, hq, dh)
@@ -109,7 +114,7 @@ def fa3_func_with_sink(
         d_hi_a[i] = d_hi + shift if d_hi < BAND_INF else BAND_INF
     out, lse = _run_packed(
         qp, kp, vp, qr, kr, d_lo_a, d_hi_a,
-        sink, softmax_scale, softcap, backend,
+        sink, softmax_scale, softcap, backend, sink_layout,
     )
     out = out.reshape(b, sq, hq, dv)
     if return_attn_probs:
@@ -156,7 +161,8 @@ def fa3_varlen_func_with_sink(
         d_lo[i] = max(-BAND_INF, lo + shift) if lo > -BAND_INF else -BAND_INF
         d_hi[i] = min(BAND_INF, hi + shift) if hi < BAND_INF else BAND_INF
     out, lse = _run_packed(
-        q, k, v, qr, kr, d_lo, d_hi, sink, softmax_scale, softcap, backend
+        q, k, v, qr, kr, d_lo, d_hi, sink, softmax_scale, softcap, backend,
+        sink_layout,
     )
     if return_attn_probs:
         return out, lse

@@ -283,8 +283,45 @@ def _stack_plans(args: list[AttnArg], sq: int, sk: int, bq: int, bk: int):
     return stacked, (nqt, nkt, w, wt, overrides)
 
 
+class DeferredTilePolicy:
+    """Deferred auto-tile state shared by the CP runtimes.
+
+    Auto-tile must score the VMEM guard with the REAL head dims and dtype
+    (r3 advisor finding), which are only known at the first calc_attn —
+    so plan building defers when the policy is active. Subclasses provide
+    ``_build_plans(blk_q, blk_k)`` and ``_tile_geoms() -> (geoms, sq, sk)``.
+    """
+
+    def _init_tile_policy(self, block_q, block_k) -> None:
+        self._plan_sig = None
+        self._auto_tile_pending = False
+        if (
+            block_q is None and block_k is None
+            and not env_kernel.ffa_blocks_pinned()
+        ):
+            from ..kernels.tile_policy import auto_tile_enabled
+
+            self._auto_tile_pending = auto_tile_enabled()
+        if not self._auto_tile_pending:
+            self._build_plans(block_q, block_k)
+
+    def _ensure_auto_plans(self, d: int, dv: int, itemsize: int) -> None:
+        """Choose tiles with the real data signature; rebuild on change."""
+        if not self._auto_tile_pending:
+            return
+        sig = (d, dv, itemsize)
+        if self._plan_sig == sig:
+            return
+        from ..kernels.tile_policy import choose_blocks_multi
+
+        geoms, sq, sk = self._tile_geoms()
+        blk_q, blk_k = choose_blocks_multi(geoms, sq, sk, d, dv, itemsize)
+        self._build_plans(blk_q, blk_k)
+        self._plan_sig = sig
+
+
 @dataclass(eq=False)
-class DistAttnRuntime:
+class DistAttnRuntime(DeferredTilePolicy):
     """Compiled-plan holder for one (mask, mesh, config) combination."""
 
     comm_meta: CommMeta
@@ -303,56 +340,14 @@ class DistAttnRuntime:
     head_axis: str | None = None
 
     def __post_init__(self) -> None:
-        from ..kernels.ffa import default_blocks
-
         cm, km = self.comm_meta, self.calc_meta
         self.cp_size = len(km.host_args)
-        shard = km.shard_len
         kv_shard = km.kv_shard_len
-        total_recv = sum(km.recv_len_per_stage)
         self.num_stages = len(cm.kv_stages)
         if self.use_overlap is None:
             self.use_overlap = self.num_stages > 1
 
-        blk_q, blk_k = self.block_q, self.block_k
-        if blk_q is None and blk_k is None and not env_kernel.ffa_blocks_pinned():
-            from ..kernels.tile_policy import (
-                auto_tile_enabled, choose_blocks_multi,
-            )
-
-            if auto_tile_enabled():
-                # per-mask tile choice scored on the merged per-rank
-                # geometries (every rank runs the max-W padded grid)
-                blk_q, blk_k = choose_blocks_multi(
-                    [
-                        (a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
-                        for a in km.merged_args
-                    ],
-                    shard, kv_shard + total_recv,
-                )
-        bq, bk = default_blocks(shard, kv_shard + total_recv, blk_q, blk_k)
-        self._bq, self._bk = bq, bk
-
-        # merged (no-overlap) plan
-        self._merged_arrays, self._merged_dims = _stack_plans(
-            km.merged_args, shard, kv_shard + total_recv, bq, bk
-        )
-
-        if self.use_overlap:
-            self._host_arrays, self._host_dims = _stack_plans(
-                km.host_args, shard, kv_shard,
-                bq, min(bk, _ceil_to(kv_shard, 128)),
-            )
-            self._stage_arrays = []
-            self._stage_dims = []
-            for st in range(self.num_stages):
-                rl = km.recv_len_per_stage[st]
-                sa, sdims = _stack_plans(
-                    km.remote_args_per_stage[st], shard, rl,
-                    bq, min(bk, _ceil_to(rl, 128)),
-                )
-                self._stage_arrays.append(sa)
-                self._stage_dims.append(sdims)
+        self._init_tile_policy(self.block_q, self.block_k)
 
         # comm arrays (host-planned, stacked over ranks)
         self._hier = (
@@ -416,6 +411,60 @@ class DistAttnRuntime:
         self._merged_slices = tuple(
             jnp.asarray(np.stack([getattr(a, f) for a in padded]))
             for f in ("q_ranges", "k_ranges", "d_lo", "d_hi")
+        )
+
+    def _build_plans(self, blk_q, blk_k) -> None:
+        """Stack the per-rank FFA plans for the chosen (or default) tiles.
+
+        May run inside a jit trace (auto-tile defers to the first
+        calc_attn), so the plan constants are forced concrete — caching
+        trace-local tracers on ``self`` would leak them into later traces.
+        """
+        with jax.ensure_compile_time_eval():
+            self._build_plans_impl(blk_q, blk_k)
+
+    def _build_plans_impl(self, blk_q, blk_k) -> None:
+        from ..kernels.ffa import default_blocks
+
+        km = self.calc_meta
+        shard = km.shard_len
+        kv_shard = km.kv_shard_len
+        total_recv = sum(km.recv_len_per_stage)
+        bq, bk = default_blocks(shard, kv_shard + total_recv, blk_q, blk_k)
+        self._bq, self._bk = bq, bk
+
+        # merged (no-overlap) plan
+        self._merged_arrays, self._merged_dims = _stack_plans(
+            km.merged_args, shard, kv_shard + total_recv, bq, bk
+        )
+
+        if self.use_overlap:
+            self._host_arrays, self._host_dims = _stack_plans(
+                km.host_args, shard, kv_shard,
+                bq, min(bk, _ceil_to(kv_shard, 128)),
+            )
+            self._stage_arrays = []
+            self._stage_dims = []
+            for st in range(self.num_stages):
+                rl = km.recv_len_per_stage[st]
+                sa, sdims = _stack_plans(
+                    km.remote_args_per_stage[st], shard, rl,
+                    bq, min(bk, _ceil_to(rl, 128)),
+                )
+                self._stage_arrays.append(sa)
+                self._stage_dims.append(sdims)
+
+    def _tile_geoms(self):
+        # per-mask tile choice scored on the merged per-rank geometries
+        # (every rank runs the max-W padded grid)
+        km = self.calc_meta
+        return (
+            [
+                (a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
+                for a in km.merged_args
+            ],
+            km.shard_len,
+            km.kv_shard_len + sum(km.recv_len_per_stage),
         )
 
     def _kind(self, stage: int):
@@ -571,6 +620,10 @@ class DistAttnRuntime:
                 check_vma=False,
             )
             return fn(q, k, v, self._cast_ops, self._merged_slices)
+
+        # auto-tile runs HERE (not __post_init__) so the VMEM guard sees
+        # the real head dims and dtype (r3 advisor finding)
+        self._ensure_auto_plans(dh, dv, q.dtype.itemsize)
 
         # fp32 wire reduce for partial dkv (ref decision at dist_attn.py
         # :243-248; default off there and here). The sdpa/jnp backends keep

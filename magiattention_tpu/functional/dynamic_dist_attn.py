@@ -45,7 +45,7 @@ from ..kernels.ffa import (
 )
 from ..meta.collection.dynamic_meta import DynamicAttnPlan
 from ..utils.profiling import instrument_scope, profile_scope
-from .dist_attn import _head_major, _stack_plans
+from .dist_attn import DeferredTilePolicy, _head_major, _stack_plans
 from .utils import lse_weighted_reduce
 
 NEG_INF = float("-inf")
@@ -188,7 +188,7 @@ _dyn_attn_shard.defvjp(_dyn_fwd, _dyn_bwd)
 
 
 @dataclass(eq=False)
-class DynamicDistAttnRuntime:
+class DynamicDistAttnRuntime(DeferredTilePolicy):
     """Executable runtime for one DynamicAttnPlan (qo-comm engine)."""
 
     plan: DynamicAttnPlan
@@ -201,25 +201,10 @@ class DynamicDistAttnRuntime:
 
     def __post_init__(self) -> None:
         p = self.plan
-        blk_q, blk_k = self.block_q, self.block_k
-        if blk_q is None and blk_k is None and not env_kernel.ffa_blocks_pinned():
-            from ..kernels.tile_policy import (
-                auto_tile_enabled, choose_blocks_multi,
-            )
+        # auto-tile defers to the first calc_attn where the real head
+        # dims/dtype are known (DeferredTilePolicy; r3 advisor finding)
+        self._init_tile_policy(self.block_q, self.block_k)
 
-            if auto_tile_enabled():
-                blk_q, blk_k = choose_blocks_multi(
-                    [
-                        (a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
-                        for a in p.attn_args
-                    ],
-                    p.q_buf_len, p.k_buf_len,
-                )
-        bq, bk = default_blocks(p.q_buf_len, p.k_buf_len, blk_q, blk_k)
-        self._bq, self._bk = bq, bk
-        self._arrays, self._dims = _stack_plans(
-            p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk
-        )
         def ops_of(cast):
             # per-stage tier from the solver's AUTO choice (cast.lowering)
             if cast.lowering == "ragged":
@@ -242,6 +227,28 @@ class DynamicDistAttnRuntime:
         (k_ops, self._k_kind) = ops_of(p.kv_cast)
         (r_ops, self._r_kind) = ops_of(p.ret)
         self._comm = (q_ops, k_ops, r_ops, (jnp.asarray(p.merge_idx),))
+
+    def _build_plans(self, blk_q, blk_k) -> None:
+        # may run inside a jit trace (deferred auto-tile): force the plan
+        # constants concrete so no tracer is cached on self
+        with jax.ensure_compile_time_eval():
+            p = self.plan
+            bq, bk = default_blocks(p.q_buf_len, p.k_buf_len, blk_q, blk_k)
+            self._bq, self._bk = bq, bk
+            self._arrays, self._dims = _stack_plans(
+                p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk
+            )
+
+    def _tile_geoms(self):
+        p = self.plan
+        return (
+            [
+                (a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
+                for a in p.attn_args
+            ],
+            p.q_buf_len,
+            p.k_buf_len,
+        )
 
     @property
     def backend(self) -> str:
@@ -277,6 +284,8 @@ class DynamicDistAttnRuntime:
         if self.backend in ("sdpa", "sdpa_online"):
             return self._calc_attn_sdpa(q, k, v, scale, return_max_logits)
 
+        # auto-tile with the real head dims/dtype (r3 advisor finding)
+        self._ensure_auto_plans(dh, dv, q.dtype.itemsize)
         nqt, nkt, w, wt, overrides = self._dims
         params = FFAParams(
             num_work=w, num_work_t=wt, num_q_tiles=nqt, num_k_tiles=nkt,

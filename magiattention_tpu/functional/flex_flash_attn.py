@@ -48,6 +48,7 @@ def flex_flash_attn_func(
     softmax_scale: float | None = None,
     softcap: float = 0.0,
     sink: jax.Array | None = None,
+    sink_layout: str = "sh",
     deterministic: bool = False,
     backend: str | None = None,
     return_max_logits: bool = False,
@@ -107,7 +108,7 @@ def flex_flash_attn_func(
             out, lse = _ffa_with_sink(
                 q, k, v, sink, qr, kr, tmap,
                 softmax_scale=softmax_scale, softcap=softcap,
-                d_lo=d_lo, d_hi=d_hi,
+                d_lo=d_lo, d_hi=d_hi, sink_layout=sink_layout,
             )
         else:
             from ..kernels.ffa import ffa_attn
@@ -129,7 +130,7 @@ def flex_flash_attn_func(
         # the sink in afterwards is gradient-exact automatically
         from .sink import apply_sink_fwd
 
-        out, lse = apply_sink_fwd(out, lse, sink)
+        out, lse = apply_sink_fwd(out, lse, sink, sink_layout)
 
     meta = AttnForwardMeta(lse=lse)
     if return_max_logits:
@@ -157,7 +158,7 @@ def flex_flash_attn_func(
 
 def _ffa_with_sink(
     q, k, v, sink, qr, kr, tmap, *, softmax_scale, softcap,
-    d_lo=None, d_hi=None,
+    d_lo=None, d_hi=None, sink_layout="sh",
 ):
     from ..kernels.ffa import (
         FFAParams,
@@ -193,16 +194,16 @@ def _ffa_with_sink(
         softcap=float(softcap), group=hq // hk,
         interpret=_should_interpret(),
     )
-    return _ffa_sink_core(q, k, v, sink, arrays, params)
+    return _ffa_sink_core(q, k, v, sink, arrays, params, sink_layout)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(5,))
-def _ffa_sink_core(q, k, v, sink, arrays, params):
-    out, lse = _ffa_sink_fwd_impl(q, k, v, sink, arrays, params)
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _ffa_sink_core(q, k, v, sink, arrays, params, sink_layout="sh"):
+    out, lse = _ffa_sink_fwd_impl(q, k, v, sink, arrays, params, sink_layout)
     return out, lse
 
 
-def _ffa_sink_fwd_impl(q, k, v, sink, arrays, params):
+def _ffa_sink_fwd_impl(q, k, v, sink, arrays, params, sink_layout="sh"):
     from ..kernels.ffa import ffa_fwd_pallas_dispatch
     from .dist_attn import _head_major
     from .sink import apply_sink_fwd
@@ -215,15 +216,15 @@ def _ffa_sink_fwd_impl(q, k, v, sink, arrays, params):
     )
     out = out_t.transpose(1, 0, 2)[: q.shape[0]]
     lse = lse_t.T[: q.shape[0]]
-    return apply_sink_fwd(out, lse, sink)
+    return apply_sink_fwd(out, lse, sink, sink_layout)
 
 
-def _ffa_sink_core_fwd(q, k, v, sink, arrays, params):
-    out, lse = _ffa_sink_fwd_impl(q, k, v, sink, arrays, params)
+def _ffa_sink_core_fwd(q, k, v, sink, arrays, params, sink_layout):
+    out, lse = _ffa_sink_fwd_impl(q, k, v, sink, arrays, params, sink_layout)
     return (out, lse), (q, k, v, sink, out, lse, arrays)
 
 
-def _ffa_sink_core_bwd(params, res, cts):
+def _ffa_sink_core_bwd(params, sink_layout, res, cts):
     from ..kernels.ffa import (
         _bwd_plan_slices,
         _ffa_bwd_dkv_pallas,
@@ -254,7 +255,7 @@ def _ffa_sink_core_bwd(params, res, cts):
         params, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     # dk/dv already per kv head (dkv kernel sums the GQA group)
-    dsink = sink_bwd(sink, lse, delta)
+    dsink = sink_bwd(sink, lse, delta, sink_layout)
     return (
         dq_t.transpose(1, 0, 2)[:sq].astype(q.dtype),
         dk_t.transpose(1, 0, 2)[: k.shape[0]].astype(k.dtype),

@@ -1,16 +1,24 @@
 """Attention-sink support (ref: extensions/fa{2,3,4}_interface_with_sink.py,
-ref_attn.py init_lse_with_sink).
+ref_attn.py init_lse_with_sink; layout math calc_lse_sink,
+magi_attention/functional/utils.py:235).
 
 Sink tokens contribute learnable logits to every query row's softmax
-normalization but no value vectors: with per-token-per-head sink logits
-``sink (s_sink, h)``,
+normalization but no value vectors. Layouts (ref utils.py:244-247):
 
-    lse' = logaddexp(lse, logsumexp_j sink[j])       (per row, per head)
+    sh:  ``(s_sink, h)``     — one shared sink strip for every query row
+    ssh: ``(sq, s_sink, h)`` — per-query-row sink logits
+    shd: ``(s_sink, h, d)``  — NotImplementedError, matching the reference
+                               exactly (utils.py:277 "not supported yet")
+
+With per-row sink lse ``L_i = logsumexp_j sink[(i,)j,h]``:
+
+    lse' = logaddexp(lse, L)                         (per row, per head)
     out' = out * exp(lse - lse')
 
 Gradients use the same final-lse identity as the distributed merge: the
 kernel backward runs against lse', which renormalizes dq/dk/dv exactly, and
-    dsink[j, h] = -sum_i exp(sink[j,h] - lse'[i,h]) * delta[i,h]
+    sh:  dsink[j, h]    = -sum_i exp(sink[j,h] - lse'[i,h]) * delta[i,h]
+    ssh: dsink[i, j, h] = -exp(sink[i,j,h] - lse'[i,h]) * delta[i,h]
 with delta = rowsum(do * out').
 """
 
@@ -20,36 +28,78 @@ import jax
 import jax.numpy as jnp
 
 
+def check_sink_layout(sink_layout: str) -> None:
+    """The ONE place the supported-layout set is decided (ref
+    _check_sink_layout, fa3_interface_with_sink.py:411; 'shd' raises
+    exactly as the reference's calc_lse_sink does, utils.py:277)."""
+    if sink_layout == "shd":
+        raise NotImplementedError(
+            "sink_layout='shd' is not supported — matching the reference "
+            "(magi_attention/functional/utils.py:277)"
+        )
+    if sink_layout not in ("sh", "ssh"):
+        raise ValueError(f"invalid sink_layout: {sink_layout!r}")
+
+
+def _sink_lse(sink: jax.Array, sink_layout: str, seqlen_q: int) -> jax.Array:
+    """Per-row sink normalizer ``(s, h)`` (ref calc_lse_sink, utils.py:235)."""
+    check_sink_layout(sink_layout)
+    s32 = sink.astype(jnp.float32)
+    if sink_layout == "sh":
+        if sink.ndim != 2:
+            raise ValueError(f"'sh' sink must be (s_sink, h), got {sink.shape}")
+        return jnp.broadcast_to(
+            jax.scipy.special.logsumexp(s32, axis=0)[None, :],
+            (seqlen_q, sink.shape[1]),
+        )
+    if sink.ndim != 3 or sink.shape[0] != seqlen_q:
+        raise ValueError(
+            f"'ssh' sink must be (seqlen_q={seqlen_q}, s_sink, h), "
+            f"got {sink.shape}"
+        )
+    return jax.scipy.special.logsumexp(s32, axis=1)
+
+
 def apply_sink_fwd(
-    out: jax.Array, lse: jax.Array, sink: jax.Array
+    out: jax.Array,
+    lse: jax.Array,
+    sink: jax.Array,
+    sink_layout: str = "sh",
 ) -> tuple[jax.Array, jax.Array]:
     """(out, lse) without sink -> (out', lse') with sink folded in.
 
     Args:
-        out: ``(s, h, dv)``; lse: ``(s, h)`` fp32; sink: ``(s_sink, h)``.
+        out: ``(s, h, dv)``; lse: ``(s, h)`` fp32; sink: see module doc.
     """
-    sink_lse = jax.scipy.special.logsumexp(
-        sink.astype(jnp.float32), axis=0
-    )  # (h,)
+    sink_lse = _sink_lse(sink, sink_layout, lse.shape[0])  # (s, h)
     neg = jnp.isneginf(lse)
-    lse_new = jnp.logaddexp(jnp.where(neg, -jnp.inf, lse), sink_lse[None, :])
+    lse_new = jnp.logaddexp(jnp.where(neg, -jnp.inf, lse), sink_lse)
     w = jnp.exp(jnp.where(neg, -jnp.inf, lse - jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)))
     out_new = (out.astype(jnp.float32) * w[..., None]).astype(out.dtype)
     return out_new, lse_new
 
 
 def sink_bwd(
-    sink: jax.Array, lse_final: jax.Array, delta: jax.Array
+    sink: jax.Array,
+    lse_final: jax.Array,
+    delta: jax.Array,
+    sink_layout: str = "sh",
 ) -> jax.Array:
-    """dsink from the final lse and delta (ref functional/utils.py sink_bwd).
+    """dsink from the final lse and delta (ref compute_dsink,
+    fa3_interface_with_sink.py:371).
 
     Args:
-        sink: ``(s_sink, h)``; lse_final: ``(s, h)``; delta: ``(s, h)`` =
-            rowsum(do * out_final), fp32.
+        sink: layout per module doc; lse_final: ``(s, h)``; delta: ``(s, h)``
+            = rowsum(do * out_final), fp32.
     """
-    # p_sink[i, j, h] = exp(sink[j,h] - lse'[i,h])
-    w = jnp.exp(
-        sink.astype(jnp.float32)[None, :, :]
-        - jnp.where(jnp.isneginf(lse_final), jnp.inf, lse_final)[:, None, :]
-    )  # rows with -inf lse' have no mass anywhere -> w = 0
-    return (-jnp.einsum("ijh,ih->jh", w, delta)).astype(sink.dtype)
+    check_sink_layout(sink_layout)
+    # rows with -inf lse' have no mass anywhere -> w = 0
+    lse_safe = jnp.where(jnp.isneginf(lse_final), jnp.inf, lse_final)
+    if sink_layout == "sh":
+        # p_sink[i, j, h] = exp(sink[j,h] - lse'[i,h])
+        w = jnp.exp(
+            sink.astype(jnp.float32)[None, :, :] - lse_safe[:, None, :]
+        )
+        return (-jnp.einsum("ijh,ih->jh", w, delta)).astype(sink.dtype)
+    w = jnp.exp(sink.astype(jnp.float32) - lse_safe[:, None, :])
+    return (-w * delta[:, None, :]).astype(sink.dtype)

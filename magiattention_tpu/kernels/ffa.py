@@ -921,15 +921,17 @@ def _ffa_bwd_dq_pallas_gqa(
     return dq_g.reshape(hq, sqp, d) * params.softmax_scale
 
 
-def _use_gqa_pack_dq(params: FFAParams) -> bool:
+def _use_gqa_pack_dq(params: FFAParams, d: int) -> bool:
     """Trace-time dispatch to the packed dq kernel: opt-in flag, real
     grouping, VMEM guard on the packed (g*bq, bk) fp32 score tile +
-    (g*bq, d) fp32 scratch."""
+    (g*bq, 2*d) fp32 scratch (dq accumulator + dp tile) with the REAL
+    head_dim — a hardcoded 256 underestimated residency at d > 256
+    (r3 advisor finding)."""
     bq, bk = params.dq_blocks()
     return (
         env_kernel.ffa_gqa_pack_dq()
         and params.group > 1
-        and params.group * bq * (bk + 256) * 4 <= 8 * 1024 * 1024
+        and params.group * bq * (bk + 2 * d) * 4 <= 8 * 1024 * 1024
     )
 
 
@@ -942,7 +944,7 @@ def ffa_bwd_dq_pallas_dispatch(
     uses so the packed dq kernel is reachable from all of them (mirrors
     :func:`ffa_fwd_pallas_dispatch`)."""
     fn = (
-        _ffa_bwd_dq_pallas_gqa if _use_gqa_pack_dq(params)
+        _ffa_bwd_dq_pallas_gqa if _use_gqa_pack_dq(params, q_t.shape[2])
         else _ffa_bwd_dq_pallas
     )
     return fn(params, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t,

@@ -71,10 +71,19 @@ REF_ALL = {
 
 def test_ref_all_names_accounted_for():
     """REF_ALL must cover the reference's __all__ exactly — no silent
-    omissions (every excluded name carries a recorded reason)."""
+    omissions (every excluded name carries a recorded reason). Skips when
+    no reference checkout is present (MAGI_REFERENCE_ROOT overrides the
+    default location)."""
+    import os
     import re
 
-    src = open("/root/reference/magi_attention/api/__init__.py").read()
+    import pytest
+
+    ref_root = os.environ.get("MAGI_REFERENCE_ROOT", "/root/reference")
+    path = os.path.join(ref_root, "magi_attention/api/__init__.py")
+    if not os.path.exists(path):
+        pytest.skip(f"reference checkout not found at {ref_root}")
+    src = open(path).read()
     m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
     assert m, "reference __all__ not found"
     ref_names = set(re.findall(r'"([^"]+)"', m.group(1)))

@@ -146,11 +146,10 @@ def test_cp_runtime_honors_auto_tile(monkeypatch):
     key = magi_attn_flex_key(
         [[0, s]], [[0, s]], [1], s, s, mesh=mesh, chunk_size=32,
     )
-    # the runtime's blocks must be a policy candidate clamped to the
-    # per-rank padded geometry, not the (256, 512) default necessarily —
-    # at minimum the choice must round-trip numerically
+    # auto-tile DEFERS plan building to the first calc_attn, where the
+    # real head dims/dtype feed the VMEM guard (r3 advisor finding)
     rt = _mgr(key).runtime
-    assert rt._bq % 16 == 0 and rt._bk % 128 == 0
+    assert rt._auto_tile_pending and not hasattr(rt, "_bq")
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((s, h, d)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((s, h, d)), jnp.float32)
@@ -159,6 +158,9 @@ def test_cp_runtime_honors_auto_tile(monkeypatch):
         dispatch(q, key), dispatch(k, key, role="kv"),
         dispatch(v, key, role="kv"), key,
     )
+    # the choice ran with the REAL dims signature and is TPU-aligned
+    assert rt._plan_sig == (d, d, 4)
+    assert rt._bq % 16 == 0 and rt._bk % 128 == 0
     out = undispatch(out_d, key)
     mask = AttnMask.from_ranges(
         AttnRanges.from_ranges([[0, s]]), AttnRanges.from_ranges([[0, s]]),

@@ -1,0 +1,90 @@
+"""Sink layouts 'sh' and 'ssh' (ref calc_lse_sink,
+magi_attention/functional/utils.py:235-279; 'shd' raises there too)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.extensions.fa_interface_with_sink import (
+    fa3_func_with_sink,
+)
+
+B, S, H, D = 2, 64, 2, 32
+S_SINK = 3
+
+
+def _data(rng):
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    return q, k, v
+
+
+def dense_with_sink(q, k, v, sink_logits):
+    """Independent oracle: softmax over [keys | sink slots] with zero
+    values at sink slots. sink_logits: (B, S, S_SINK, H)."""
+    s = jnp.einsum("bihd,bjhd->bhij", q, k) * (D ** -0.5)
+    s_aug = jnp.concatenate(
+        [s, sink_logits.transpose(0, 3, 1, 2)], axis=-1
+    )  # (B, H, S, S + S_SINK)
+    p = jax.nn.softmax(s_aug, axis=-1)[..., :S]
+    return jnp.einsum("bhij,bjhd->bihd", p, v)
+
+
+def test_ssh_matches_dense_oracle():
+    rng = np.random.default_rng(0)
+    q, k, v = _data(rng)
+    sink = jnp.asarray(
+        rng.standard_normal((B, S, S_SINK, H)), jnp.float32
+    )
+    out = fa3_func_with_sink(q, k, v, sink=sink, sink_layout="ssh")
+    out_ref = dense_with_sink(q, k, v, sink)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(out_ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_sh_equals_ssh_with_broadcast_sink():
+    rng = np.random.default_rng(1)
+    q, k, v = _data(rng)
+    sink_sh = jnp.asarray(rng.standard_normal((S_SINK, H)), jnp.float32)
+    sink_ssh = jnp.broadcast_to(sink_sh[None, None], (B, S, S_SINK, H))
+    out_sh = fa3_func_with_sink(q, k, v, sink=sink_sh, sink_layout="sh")
+    out_ssh = fa3_func_with_sink(q, k, v, sink=sink_ssh, sink_layout="ssh")
+    np.testing.assert_allclose(
+        np.asarray(out_sh), np.asarray(out_ssh), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ssh_grads_match_dense_oracle():
+    rng = np.random.default_rng(2)
+    q, k, v = _data(rng)
+    sink = jnp.asarray(
+        rng.standard_normal((B, S, S_SINK, H)), jnp.float32
+    )
+    w = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+
+    def loss(q, k, v, sink):
+        return jnp.sum(
+            fa3_func_with_sink(q, k, v, sink=sink, sink_layout="ssh") * w
+        )
+
+    def loss_ref(q, k, v, sink):
+        return jnp.sum(dense_with_sink(q, k, v, sink) * w)
+
+    g = jax.grad(loss, argnums=(0, 1, 2, 3))(q, k, v, sink)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, sink)
+    for name, a, b in zip("q k v sink".split(), g, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name}",
+        )
+
+
+def test_shd_raises_like_reference():
+    rng = np.random.default_rng(3)
+    q, k, v = _data(rng)
+    sink = jnp.asarray(rng.standard_normal((S_SINK, H, D)), jnp.float32)
+    with pytest.raises(NotImplementedError):
+        fa3_func_with_sink(q, k, v, sink=sink, sink_layout="shd")
