@@ -158,7 +158,12 @@ def run_worker() -> int:
     try:
         if backend == "cpu":
             raise _FallbackTiming("interpret mode: skip scan timing")
-        dt_ms = do_bench_scan_slope(make_body(block_q, block_k), q, reps=2)
+        # seq-8192 steps are ~4x the 4096 cost; (8, 32) keeps the slope
+        # pair inside the worker budget while still cancelling the fixed
+        # launch cost
+        dt_ms = do_bench_scan_slope(
+            make_body(block_q, block_k), q, lengths=(8, 32), reps=2
+        )
         sweep_points.append(
             {"block_q": block_q, "block_k": block_k, "tflops": tf(dt_ms)}
         )
@@ -171,7 +176,9 @@ def run_worker() -> int:
             if time.perf_counter() - _T_PROC_START > 180:
                 break
             try:
-                alt_ms = do_bench_scan_slope(make_body(bq2, bk2), q, reps=2)
+                alt_ms = do_bench_scan_slope(
+                    make_body(bq2, bk2), q, lengths=(8, 32), reps=2
+                )
                 sweep_points.append(
                     {"block_q": bq2, "block_k": bk2, "tflops": tf(alt_ms)}
                 )
@@ -194,7 +201,7 @@ def run_worker() -> int:
             try:
                 os.environ.update(packs)
                 pk_ms = do_bench_scan_slope(
-                    make_body(block_q, block_k), q, reps=2
+                    make_body(block_q, block_k), q, lengths=(8, 32), reps=2
                 )
                 sweep_points.append({
                     "block_q": block_q, "block_k": block_k,

@@ -1,0 +1,134 @@
+"""Shared plan-fingerprint machinery for the golden-plan solver tests.
+
+The reference pins expected rank entries / transfer tables / metas as
+literal data for many masks (tests/test_attn_solver/test_dist_attn_solver.py,
+2,906 LoC) so any solver change fails loudly instead of slipping past
+invariant-only property tests. Here the same guarantee comes from a
+deterministic serialization of the ENTIRE plan (dispatch partitions,
+per-stage transfer tables + send_counts + lowering choice, per-rank
+host/remote/merged band slices, buffer lengths) hashed to a fingerprint —
+plus small human-readable facets pinned literally so a failure shows WHAT
+moved, not just that something did.
+
+To regenerate after an INTENTIONAL solver change:
+    python tests/test_solver/golden_plan_lib.py   # prints the new dict
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.meta import (
+    make_attn_meta_from_dispatch_meta,
+    make_dispatch_meta_from_qk_ranges,
+)
+
+SEQ = 2048
+CHUNK = 128
+
+
+def canonical_masks() -> dict[str, tuple]:
+    """name -> (q_ranges, k_ranges, mask_types). SEQ rows each."""
+    s = SEQ
+    h = s // 2
+    return {
+        "full": ([[0, s]], [[0, s]], [AttnMaskType.FULL]),
+        "causal": ([[0, s]], [[0, s]], [AttnMaskType.CAUSAL]),
+        "varlen_block_causal": (
+            [[0, h], [h, s]], [[0, h], [h, s]],
+            [AttnMaskType.CAUSAL, AttnMaskType.CAUSAL],
+        ),
+        "inv_causal": ([[0, s]], [[0, s]], [AttnMaskType.INVCAUSAL]),
+        "shared_prefix": (
+            # all rows attend a shared prefix; tail is causal over itself
+            [[0, s], [256, s]], [[0, 256], [256, s]],
+            [AttnMaskType.FULL, AttnMaskType.CAUSAL],
+        ),
+        "block_sparse": (
+            [[0, 512], [512, 1024], [1024, 1536], [1536, 2048], [0, s]],
+            [[0, 512], [0, 1024], [512, 1536], [1024, 2048], [0, 256]],
+            [AttnMaskType.CAUSAL, AttnMaskType.FULL, AttnMaskType.FULL,
+             AttnMaskType.CAUSAL, AttnMaskType.FULL],
+        ),
+    }
+
+
+def build_plan(name: str, cp: int):
+    qr, kr, tm = canonical_masks()[name]
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        list(tm), SEQ, SEQ, CHUNK, cp,
+    )
+    cmm, calc = make_attn_meta_from_dispatch_meta(bucket, mq)
+    return mq, cmm, calc
+
+
+def _h(hasher, arr) -> None:
+    a = np.ascontiguousarray(np.asarray(arr))
+    hasher.update(str(a.dtype).encode())
+    hasher.update(str(a.shape).encode())
+    hasher.update(a.tobytes())
+
+
+def plan_fingerprint(mq, cmm, calc) -> str:
+    """Deterministic digest of everything the runtimes consume."""
+    hs = hashlib.sha256()
+    for part in mq.partitions:
+        _h(hs, np.asarray(part, np.int64))
+    for s in cmm.kv_stages:
+        hs.update(s.lowering.encode())
+        _h(hs, s.send_counts)
+        _h(hs, s.send_idx)
+        _h(hs, s.recv_sel)
+        _h(hs, s.recv_len)
+        for dst_row in s.transfer_table:
+            for rr in dst_row:
+                _h(hs, np.asarray(rr.to_naive_ranges(), np.int64).reshape(-1, 2))
+    for group in (calc.host_args, calc.merged_args,
+                  *calc.remote_args_per_stage):
+        for a in group:
+            _h(hs, a.q_ranges)
+            _h(hs, a.k_ranges)
+            _h(hs, a.d_lo)
+            _h(hs, a.d_hi)
+    _h(hs, np.asarray(
+        [calc.shard_len, calc.kv_shard_len or 0, *calc.recv_len_per_stage],
+        np.int64,
+    ))
+    return hs.hexdigest()[:16]
+
+
+def plan_facets(mq, cmm, calc) -> dict:
+    """Small human-readable plan facts, pinned literally."""
+    return {
+        "partitions": [list(map(int, p)) for p in mq.partitions],
+        "recv_len_per_stage": list(map(int, calc.recv_len_per_stage)),
+        "send_counts": [
+            [[int(x) for x in row] for row in s.send_counts]
+            for s in cmm.kv_stages
+        ],
+        "lowering": [s.lowering for s in cmm.kv_stages],
+        "merged_slices": [int(a.q_ranges.shape[0]) for a in calc.merged_args],
+    }
+
+
+def generate() -> dict:
+    out = {}
+    for name in canonical_masks():
+        for cp in (2, 4, 8):
+            mq, cmm, calc = build_plan(name, cp)
+            out[f"{name}/cp{cp}"] = {
+                "fingerprint": plan_fingerprint(mq, cmm, calc),
+                **plan_facets(mq, cmm, calc),
+            }
+    return out
+
+
+if __name__ == "__main__":
+    import pprint
+
+    pprint.pprint(generate(), width=78, compact=True)
