@@ -5,13 +5,13 @@ PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead
 
-test:  ## fast suite (excludes slow scale tests)
+test:  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
-test-all:  ## everything, including the 262k/131k scale oracles
+test-all:  ## everything: + model training, scale oracles, property suites
 	$(PY) -m pytest tests/ -q
 
-test-slow:  ## only the slow-marked scale tests
+test-slow:  ## only the slow tier (training / 262k-131k oracles / property)
 	$(PY) -m pytest tests/ -q -m slow
 
 bench:  ## the driver's headline benchmark (TPU when reachable)

@@ -49,7 +49,9 @@ def test_32k_window_sink_planning():
     assert plan.num_work < causal_tiles // 4
 
 
-@pytest.mark.parametrize("sink", [0, 16])
+@pytest.mark.parametrize(
+    "sink", [0, pytest.param(16, marks=pytest.mark.slow)]
+)
 def test_window_sink_numeric(sink):
     """Same code path at 2048 tokens vs the dense reference."""
     S = 2048

@@ -6,6 +6,11 @@ math. Incompatible overrides (not dividing the fwd-padded geometry) must
 silently inherit the fwd blocks.
 """
 
+import pytest
+
+# heavy kernel/pipeline suite: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

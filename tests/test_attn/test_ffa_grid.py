@@ -15,6 +15,12 @@ case and are in-contract).
 
 from __future__ import annotations
 
+import pytest
+
+# heavy property/e2e suites: the slow tier (make test-all); the fast
+# tier keeps this area covered via its smaller sibling files
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

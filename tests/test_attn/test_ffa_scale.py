@@ -7,6 +7,11 @@ Interpret mode on CPU; the same code path compiles under Mosaic on TPU
 (scripts/tpu_smoke.py).
 """
 
+import pytest
+
+# heavy kernel/pipeline suite: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

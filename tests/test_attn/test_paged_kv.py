@@ -3,6 +3,7 @@
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from magiattention_tpu.kernels.paged_kv import (
@@ -66,6 +67,7 @@ def test_paged_decode_matches_dense():
     assert_close(lse, rlse, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_paged_prefill_chunk_matches_dense():
     rng = np.random.default_rng(2)
     ctx, t = PS + 3, 8  # chunked prefill: t new q rows
@@ -87,6 +89,7 @@ def test_paged_prefill_chunk_matches_dense():
     assert_close(lse, rlse, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_paged_decode_logits_match_dense_model():
     """Greedy decode via the paged cache must produce the same per-step
     logits as the dense-causal model on the growing context."""

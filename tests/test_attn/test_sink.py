@@ -44,7 +44,9 @@ def test_sink_forward(backend):
                  msg=f"{backend} sink lse")
 
 
-@pytest.mark.parametrize("backend", ["sdpa", "ffa"])
+@pytest.mark.parametrize(
+    "backend", [pytest.param("sdpa", marks=pytest.mark.slow), "ffa"]
+)
 def test_sink_backward(backend):
     q, k, v, sink, qr, kr, tm, mask = setup(1)
     rng = np.random.default_rng(2)

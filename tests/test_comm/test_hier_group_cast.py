@@ -60,7 +60,9 @@ def _random_requests(seed, multicast=True):
 
 
 @pytest.mark.parametrize("multicast", [True, False])
-@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(1, marks=pytest.mark.slow)]
+)
 def test_hier_matches_flat(seed, multicast):
     reqs = _random_requests(seed, multicast)
     host = _host_ranges()

@@ -5,6 +5,12 @@ DeviceMesh out of local ranks; here the 8 virtual CPU devices form a 2x4
 mesh and MAGI_ATTENTION_HIERARCHICAL_COMM toggles the 2-phase cast.
 """
 
+import pytest
+
+# heavy property/e2e suites: the slow tier (make test-all); the fast
+# tier keeps this area covered via its smaller sibling files
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -7,6 +7,12 @@ the reference's true per-pair a2av splits, grpcoll/utils.py:593). Both must
 assemble byte-identical receive buffers.
 """
 
+import pytest
+
+# heavy property/e2e suites: the slow tier (make test-all); the fast
+# tier keeps this area covered via its smaller sibling files
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -14,6 +14,12 @@ checks the reduce against a dense scatter-sum oracle. TPU equivalent, on the
 - degenerate patterns: empty sends, self-only, single-row shards.
 """
 
+import pytest
+
+# heavy property/e2e suites: the slow tier (make test-all); the fast
+# tier keeps this area covered via its smaller sibling files
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -5,6 +5,12 @@ oracle: end-to-end pipeline on a chunk count NOT divisible by cp_size must
 match the dense reference, forward and backward.
 """
 
+import pytest
+
+# heavy property/e2e suites: the slow tier (make test-all); the fast
+# tier keeps this area covered via its smaller sibling files
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -45,6 +45,7 @@ def test_ssh_matches_dense_oracle():
     )
 
 
+@pytest.mark.slow
 def test_sh_equals_ssh_with_broadcast_sink():
     rng = np.random.default_rng(1)
     q, k, v = _data(rng)

@@ -60,6 +60,7 @@ def _grads(monkeypatch, hp: bool, dtype=jnp.bfloat16):
     return gfn(q, k, v), hlo
 
 
+@pytest.mark.slow
 def test_hp_flag_changes_wire_dtype(monkeypatch):
     """With the flag on, at least one backward collective carries f32."""
     _, hlo_lp = _grads(monkeypatch, hp=False)
@@ -77,6 +78,7 @@ def test_hp_flag_changes_wire_dtype(monkeypatch):
     assert f32_collectives(hlo_hp) > f32_collectives(hlo_lp)
 
 
+@pytest.mark.slow
 def test_hp_matches_lp_within_bf16_tol(monkeypatch):
     (dq_lp, dk_lp, dv_lp), _ = _grads(monkeypatch, hp=False)
     (dq_hp, dk_hp, dv_hp), _ = _grads(monkeypatch, hp=True)
@@ -87,6 +89,7 @@ def test_hp_matches_lp_within_bf16_tol(monkeypatch):
         )
 
 
+@pytest.mark.slow
 def test_hp_reduce_at_least_as_accurate(monkeypatch):
     """bf16 cp=8 vs an fp32 end-to-end oracle: the hp dk/dv error must not
     exceed the lp error (the delta the 2x comm bytes buy)."""
@@ -105,6 +108,7 @@ def test_hp_reduce_at_least_as_accurate(monkeypatch):
     assert e_hp <= e_lp * 1.02 + 1e-6
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("flag", ["0", "1"])
 def test_dynamic_runtime_consumes_flags(monkeypatch, flag):
     """qo-comm path: both HP flags produce correct out/grads (the dynamic
@@ -144,3 +148,52 @@ def test_dynamic_runtime_consumes_flags(monkeypatch, flag):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3
         )
+
+
+def test_hp_group_cast_primitive_fast():
+    """Fast-tier coverage of hp_group_cast itself: fp32 output, fp32
+    collective in the backward HLO, and gradients equal to the plain cast
+    (the e2e runtime A/Bs above are the slow tier)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from magiattention_tpu.comm.primitives import cast_rows
+    from magiattention_tpu.functional.dist_attn import hp_group_cast
+
+    cp, shard = 8, 4
+    mesh = _mesh()
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((cp * shard, 8)), jnp.bfloat16)
+    # every rank broadcasts its row 0 to all ranks (simple dense plan)
+    send_idx = np.zeros((cp, 1), np.int32)
+    recv_sel = np.arange(cp, dtype=np.int32)  # one row from each src
+    ops = (jnp.asarray(send_idx), jnp.asarray(recv_sel))
+
+    def make(f):
+        def shard_fn(x, ops):
+            return jnp.sum(
+                f(x, tuple(o for o in ops)).astype(jnp.float32) ** 2
+            )
+
+        def loss(x):
+            return jnp.sum(shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(P("cp"), (P(), P())), out_specs=P(),
+                check_vma=False,
+            )(x, ops))
+
+        return loss
+
+    hp = make(lambda x, o: hp_group_cast(
+        x, o, ("a2a",), "cp", shard, x.dtype.name))
+    lp = make(lambda x, o: cast_rows(x, o, ("a2a",), "cp"))
+
+    g_hp = jax.grad(hp)(x)
+    g_lp = jax.grad(lp)(x)
+    assert g_hp.dtype == x.dtype
+    np.testing.assert_allclose(
+        np.asarray(g_hp, np.float32), np.asarray(g_lp, np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+    hlo = jax.jit(jax.grad(hp)).lower(x).as_text()
+    assert re.search(r"all_to_all[^\n]*xf32>", hlo), "no fp32 wire reduce"

@@ -6,6 +6,11 @@ one trains with MagiAttention CP over a 4-device mesh, the other with
 replicated dense attention. Loss trajectories must track each other to
 floating-point noise."""
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -6,6 +6,11 @@ the converted weights, and the converted weights must run through the CP
 pipeline.
 """
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

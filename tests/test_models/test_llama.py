@@ -1,5 +1,10 @@
 """Flagship Llama model smoke tests on the virtual CP mesh."""
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

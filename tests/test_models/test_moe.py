@@ -6,6 +6,11 @@ reference wherever no slot overflows, EP all_to_all == single-shard
 routing bit-for-bit (same capacity), and the full CP x EP model trains.
 """
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import dataclasses
 
 import jax

@@ -6,6 +6,11 @@ calc_attn over the video mask) must match the dense replicated twin in loss,
 gradients, and short optax trajectories.
 """
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,5 +1,10 @@
 """Ulysses / Ring baselines vs the global dense reference."""
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

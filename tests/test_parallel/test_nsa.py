@@ -5,6 +5,11 @@ must reproduce the single-device nsa_attn bit-for-bit (same params, same
 static block layout).
 """
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

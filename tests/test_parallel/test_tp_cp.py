@@ -5,6 +5,11 @@ attention TP-sharded inside the same shard_map via
 ``magi_attn_flex_key(head_axis=...)``.
 """
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

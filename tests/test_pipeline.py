@@ -5,6 +5,11 @@ undispatch -> backward on a virtual CPU mesh, comparing out/lse/dq/dk/dv
 against the single-device dense reference on the global tensors.
 """
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -10,6 +10,11 @@ config runs the REAL pipeline (plan key -> dispatch -> calc_attn ->
 undispatch, + backward on a subset) against the dense fp32 oracle.
 """
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

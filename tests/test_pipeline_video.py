@@ -9,6 +9,11 @@ Two tiers:
   zero-redundant on the wire.
 """
 
+import pytest
+
+# model-training / multi-rank scale tests: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import time
 
 import jax

@@ -156,13 +156,23 @@ def _mesh(cp):
     return Mesh(np.array(jax.devices("cpu")[:cp]), axis_names=("cp",))
 
 
-@pytest.mark.parametrize("alg", ALGS)
+@pytest.mark.parametrize(
+    "alg",
+    [
+        # fast tier keeps the DEFAULT alg e2e; the other five run in the
+        # slow tier (their plan-level checks above stay fast for all six)
+        a if a == DynamicAttnAlgType.BINARY_GREEDY
+        else pytest.param(a, marks=pytest.mark.slow)
+        for a in ALGS
+    ],
+)
 @pytest.mark.parametrize("mask_name", sorted(MASKS))
 def test_qo_comm_pipeline(mask_name, alg, monkeypatch):
     monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
     _run_pipeline(mask_name, alg, backend=None, backward=False)
 
 
+@pytest.mark.slow
 def test_qo_comm_auto_tile(monkeypatch):
     """MAGI_ATTENTION_FFA_AUTO_TILE reaches the dynamic (qo-comm) runtime
     too — same oracle with the policy on."""
@@ -176,7 +186,10 @@ def test_qo_comm_auto_tile(monkeypatch):
     )
 
 
-@pytest.mark.parametrize("backend", ["sdpa", "ffa"])
+@pytest.mark.parametrize(
+    "backend",
+    ["ffa", pytest.param("sdpa", marks=pytest.mark.slow)],
+)
 def test_qo_comm_backward(backend, monkeypatch):
     monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
     if backend == "sdpa":

@@ -7,8 +7,12 @@ assert, for several cp sizes and overlap degrees, that the per-rank merged
 plans reconstruct the global mask bit-exactly (with the suite-wide sanity
 invariants on)."""
 
-import numpy as np
 import pytest
+
+# heavy kernel/pipeline suite: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
+import numpy as np
 
 from magiattention_tpu.common.enum import AttnMaskType
 from magiattention_tpu.common.mask import AttnMask

@@ -1,5 +1,6 @@
 """Aux subsystem tests: flag generator, gt dispatcher, bench harness, utils."""
 
+import pytest
 import numpy as np
 
 from magiattention_tpu.benchmarking import Benchmark, do_bench, perf_report
@@ -69,6 +70,7 @@ def test_vmem_budget_reasonable():
     assert 0 < b < 16 * 1024 * 1024  # fits one v5e core's VMEM
 
 
+@pytest.mark.slow
 def test_precision_flag_casts_to_bf16(monkeypatch):
     """MAGI_ATTENTION_PRECISION=bf16 must cast q/k/v before the kernel
     (ref precision override, functional/dist_attn.py:3760)."""

@@ -2,6 +2,11 @@
 determinism (ref: testing/template.py:77, precompile.py; comm_meta MLA
 support :588; MAGI_ATTENTION_DETERMINISTIC_MODE)."""
 
+import pytest
+
+# heavy kernel/pipeline suite: the slow tier (make test-all)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np
