@@ -128,7 +128,87 @@ def generate() -> dict:
     return out
 
 
+
+
+# ---------------------------------------------------------------------------
+# dynamic (qo-comm) plans
+# ---------------------------------------------------------------------------
+
+
+def build_dynamic_plan(name: str, cp: int):
+    """DynamicAttnPlan for a canonical mask (the qo-comm solver path)."""
+
+    from magiattention_tpu.meta._make_attn_meta import make_dynamic_attn_plan
+
+    qr, kr, tm = canonical_masks()[name]
+    mq, _, bucket = make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        list(tm), SEQ, SEQ, CHUNK, cp,
+    )
+    plan = make_dynamic_attn_plan(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        list(tm), mq,
+    )
+    return mq, plan
+
+
+def _hash_grpcoll(hs, s) -> None:
+    hs.update(s.lowering.encode())
+    _h(hs, s.send_counts)
+    _h(hs, s.send_idx)
+    _h(hs, s.recv_sel)
+    _h(hs, s.recv_len)
+
+
+def dynamic_plan_fingerprint(mq, plan) -> str:
+    hs = hashlib.sha256()
+    for part in mq.partitions:
+        _h(hs, np.asarray(part, np.int64))
+    for cast in (plan.q_cast, plan.kv_cast, plan.ret):
+        _hash_grpcoll(hs, cast)
+    _h(hs, plan.merge_idx)
+    for a in plan.attn_args:
+        _h(hs, a.q_ranges)
+        _h(hs, a.k_ranges)
+        _h(hs, a.d_lo)
+        _h(hs, a.d_hi)
+    _h(hs, np.asarray(
+        [plan.shard_len, plan.kv_shard_len, plan.q_buf_len,
+         plan.k_buf_len, plan.ret_len], np.int64,
+    ))
+    return hs.hexdigest()[:16]
+
+
+def dynamic_plan_facets(mq, plan) -> dict:
+    return {
+        "partitions": [list(map(int, p)) for p in mq.partitions],
+        "buf_lens": [int(plan.q_buf_len), int(plan.k_buf_len),
+                     int(plan.ret_len)],
+        "q_send_counts": [
+            [int(x) for x in row] for row in plan.q_cast.send_counts
+        ],
+        "kv_send_counts": [
+            [int(x) for x in row] for row in plan.kv_cast.send_counts
+        ],
+        "slices": [int(a.q_ranges.shape[0]) for a in plan.attn_args],
+    }
+
+
+def generate_dynamic() -> dict:
+    out = {}
+    for name in canonical_masks():
+        for cp in (2, 4, 8):
+            mq, plan = build_dynamic_plan(name, cp)
+            out[f"{name}/cp{cp}"] = {
+                "fingerprint": dynamic_plan_fingerprint(mq, plan),
+                **dynamic_plan_facets(mq, plan),
+            }
+    return out
+
+
 if __name__ == "__main__":
     import pprint
 
     pprint.pprint(generate(), width=78, compact=True)
+    print('# dynamic (qo-comm):')
+    pprint.pprint(generate_dynamic(), width=78, compact=True)
