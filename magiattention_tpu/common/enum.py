@@ -58,11 +58,14 @@ class AttnMaskType(Enum):
     def normalize(
         cls, value: "AttnMaskType | str | int"
     ) -> "AttnMaskType":
-        """Accept enum / str / int forms uniformly."""
+        """Accept enum / str / int forms uniformly (incl. numpy integer
+        scalars — mask metadata routinely arrives as np.int32 arrays)."""
         if isinstance(value, cls):
             return value
-        if isinstance(value, int):
-            return cls.from_int_type(value)
+        if isinstance(value, int) or (
+            hasattr(value, "__index__") and not isinstance(value, str)
+        ):
+            return cls.from_int_type(int(value))
         return cls(value)
 
 

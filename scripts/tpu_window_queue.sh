@@ -105,6 +105,29 @@ run_queue() {
   run_step 900 ".tpu_logs/${TS}_overlap.log" python -u scripts/tpu_overlap_tax.py
 }
 
+commit_results() {
+  # persist whatever the window measured, even if no operator is watching.
+  # Pathspec-limited commit: touches ONLY the measurement files — unrelated
+  # staged/working-tree state is left exactly as it was. Per-path add so a
+  # missing path can't abort staging the other; failures are LOGGED (silent
+  # loss of unattended silicon data defeats the point).
+  local paths=() p
+  for p in benchmarks/history .bench_last_tpu.json; do
+    [ -e "$p" ] || continue
+    git add "$p" 2>>"$LOG" && paths+=("$p")
+  done
+  [ "${#paths[@]}" -gt 0 ] || return 0
+  if [ -n "$(git status --porcelain -- "${paths[@]}" 2>/dev/null)" ]; then
+    if git commit -q \
+        -m "Record silicon measurements from chip window ${TS}" \
+        -- "${paths[@]}" 2>>"$LOG"; then
+      echo "[$(date -u +%H:%M:%S)] committed window results" >> "$LOG"
+    else
+      echo "[$(date -u +%H:%M:%S)] WINDOW RESULT COMMIT FAILED" >> "$LOG"
+    fi
+  fi
+}
+
 # 45 s between probes: a failed probe already burns its 90 s timeout, so
 # the worst-case window-discovery latency is ~2.25 min against windows
 # observed as short as ~4 min.
@@ -113,6 +136,7 @@ while true; do
   if probe; then
     echo "[$(date -u +%H:%M:%S)] CHIP UP — running queue" >> "$LOG"
     run_queue
+    commit_results
     echo "[$(date -u +%H:%M:%S)] QUEUE DONE — resuming probes" >> "$LOG"
   fi
   sleep 45

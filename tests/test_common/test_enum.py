@@ -1,0 +1,31 @@
+"""AttnMaskType normalize contract (ref: the kernel contract's 0-3 int
+codes, magi_attention/functional/flex_flash_attn.py:1454-1466)."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common.enum import AttnMaskType
+
+
+@pytest.mark.parametrize("v,want", [
+    (AttnMaskType.CAUSAL, AttnMaskType.CAUSAL),
+    (1, AttnMaskType.CAUSAL),
+    ("causal", AttnMaskType.CAUSAL),
+    (0, AttnMaskType.FULL),
+    (np.int32(2), AttnMaskType.INVCAUSAL),  # numpy scalars: mask metadata
+    (np.int64(3), AttnMaskType.BICAUSAL),   # routinely arrives as arrays
+])
+def test_normalize_accepts_all_forms(v, want):
+    assert AttnMaskType.normalize(v) is want
+
+
+def test_normalize_rejects_garbage():
+    with pytest.raises((ValueError, KeyError)):
+        AttnMaskType.normalize("not-a-mask")
+    with pytest.raises((ValueError, KeyError)):
+        AttnMaskType.normalize(7)
+
+
+def test_int_roundtrip():
+    for t in AttnMaskType:
+        assert AttnMaskType.normalize(t.to_int_type()) is t
