@@ -103,3 +103,37 @@ def test_kernel_output_unchanged_by_merge(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(lse_on), np.asarray(lse_off), rtol=2e-5, atol=2e-5
     )
+
+
+def test_merge_random_slices_property():
+    """60 random slice soups: merged metadata covers EXACTLY the same
+    (i, j) set, never grows the slice count, and is idempotent."""
+    rng = np.random.default_rng(42)
+    for trial in range(60):
+        n = int(rng.integers(1, 12))
+        s = int(rng.integers(32, 129))
+        qr = np.sort(rng.integers(0, s, (n, 2)), axis=1).astype(np.int32)
+        kr = np.sort(rng.integers(0, s, (n, 2)), axis=1).astype(np.int32)
+        # mix of FULL/CAUSAL-style bands and random finite bands
+        lo = np.where(
+            rng.random(n) < 0.5, -BAND_INF,
+            rng.integers(-s, s, n)
+        ).astype(np.int32)
+        hi = np.where(
+            rng.random(n) < 0.5, BAND_INF,
+            np.maximum(lo, rng.integers(-s, s, n))
+        ).astype(np.int32)
+        mq, mk, mlo, mhi = merge_band_slices(qr, kr, lo, hi)
+        assert len(mq) <= max(n, 1)
+        dense_orig = np.asarray(build_dense_mask_band(
+            jnp.asarray(qr), jnp.asarray(kr), jnp.asarray(lo),
+            jnp.asarray(hi), s, s,
+        ))
+        dense_merged = np.asarray(build_dense_mask_band(
+            jnp.asarray(mq), jnp.asarray(mk), jnp.asarray(mlo),
+            jnp.asarray(mhi), s, s,
+        ))
+        np.testing.assert_array_equal(dense_orig, dense_merged, err_msg=str(trial))
+        # idempotent
+        mq2, mk2, mlo2, mhi2 = merge_band_slices(mq, mk, mlo, mhi)
+        assert len(mq2) == len(mq), trial
