@@ -92,6 +92,35 @@ def _auto_chunk_size(
     return 1
 
 
+def _validate_mask_inputs(
+    q_ranges: AttnRanges,
+    k_ranges: AttnRanges,
+    mask_ints: tuple[int, ...],
+    total_seqlen_q: int,
+    total_seqlen_k: int,
+) -> None:
+    """Always-on key-entry validation, shared by BOTH public key entries
+    (the reference asserts these at its key entry,
+    api/magi_attn_interface.py:442ff). A count mismatch would otherwise
+    zip-TRUNCATE silently downstream (common/mask.py, api/functools.py) —
+    wrong results, no error."""
+    if not (len(q_ranges) == len(k_ranges) == len(mask_ints)):
+        raise ValueError(
+            f"q_ranges ({len(q_ranges)}), k_ranges ({len(k_ranges)}) and "
+            f"attn_mask_type ({len(mask_ints)}) must have the same length"
+        )
+    if q_ranges.end > total_seqlen_q:
+        raise ValueError(
+            f"q_ranges reach {q_ranges.end} > total_seqlen_q "
+            f"{total_seqlen_q}"
+        )
+    if k_ranges.end > total_seqlen_k:
+        raise ValueError(
+            f"k_ranges reach {k_ranges.end} > total_seqlen_k "
+            f"{total_seqlen_k}"
+        )
+
+
 def magi_attn_flex_key(
     q_ranges: AttnRanges | Sequence[Sequence[int]],
     k_ranges: AttnRanges | Sequence[Sequence[int]],
@@ -121,6 +150,9 @@ def magi_attn_flex_key(
         k_ranges = AttnRanges.from_ranges(k_ranges)
     mask_ints = tuple(
         AttnMaskType.normalize(t).to_int_type() for t in attn_mask_type
+    )
+    _validate_mask_inputs(
+        q_ranges, k_ranges, mask_ints, total_seqlen_q, total_seqlen_k
     )
     if env_general.is_sanity_check_enable():
         _check_no_overlapping_slices(q_ranges, k_ranges, mask_ints)
@@ -217,13 +249,12 @@ def make_flex_key_for_new_mask_after_dispatch(
         AttnMaskType.normalize(t).to_int_type() for t in attn_mask_type
     )
     old = key_for_dispatch
-    if q_ranges.end > old.total_seqlen_q or k_ranges.end > old.total_seqlen_k:
-        raise ValueError(
-            f"new mask exceeds the dispatched extent: q end {q_ranges.end} "
-            f"(max {old.total_seqlen_q}), k end {k_ranges.end} "
-            f"(max {old.total_seqlen_k}) — the re-keyed mask must fit the "
-            f"layout planned by key_for_dispatch"
-        )
+    # same rule set as magi_attn_flex_key — the re-keyed mask must fit the
+    # layout planned by key_for_dispatch
+    _validate_mask_inputs(
+        q_ranges, k_ranges, mask_ints,
+        old.total_seqlen_q, old.total_seqlen_k,
+    )
     key = DistAttnRuntimeKey(
         q_ranges=tuple(q_ranges.to_naive_ranges()),
         k_ranges=tuple(k_ranges.to_naive_ranges()),

@@ -1,0 +1,67 @@
+"""Always-on key-entry input validation (ref asserts at its key entry,
+api/magi_attn_interface.py:442ff). Without these, a count mismatch
+zip-truncates silently downstream — wrong results with no error."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu.api import magi_attn_flex_key
+
+S = 128
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices("cpu")[:4]), ("cp",))
+
+
+def test_mask_type_count_mismatch_raises():
+    with pytest.raises(ValueError, match="same length"):
+        magi_attn_flex_key(
+            [[0, S]], [[0, S]], [1, 1], S, S, mesh=_mesh(), chunk_size=16
+        )
+
+
+def test_qk_count_mismatch_raises():
+    with pytest.raises(ValueError, match="same length"):
+        magi_attn_flex_key(
+            [[0, S], [0, 64]], [[0, S]], [1, 1], S, S,
+            mesh=_mesh(), chunk_size=16,
+        )
+
+
+def test_range_beyond_seqlen_raises():
+    with pytest.raises(ValueError, match="total_seqlen_q"):
+        magi_attn_flex_key(
+            [[0, 2 * S]], [[0, S]], [1], S, S, mesh=_mesh(), chunk_size=16
+        )
+    with pytest.raises(ValueError, match="total_seqlen_k"):
+        magi_attn_flex_key(
+            [[0, S]], [[0, 2 * S]], [1], S, S, mesh=_mesh(), chunk_size=16
+        )
+
+
+def test_valid_inputs_still_accepted():
+    key = magi_attn_flex_key(
+        [[0, S]], [[0, S]], [1], S, S, mesh=_mesh(), chunk_size=16
+    )
+    assert key is not None
+
+
+def test_rekey_entry_validates_too():
+    from magiattention_tpu.api import (
+        make_flex_key_for_new_mask_after_dispatch,
+    )
+
+    key0 = magi_attn_flex_key(
+        [[0, S]], [[0, S]], [1], S, S, mesh=_mesh(), chunk_size=16
+    )
+    with pytest.raises(ValueError, match="same length"):
+        make_flex_key_for_new_mask_after_dispatch(
+            [[0, S], [0, 64]], [[0, S]], ["causal", "causal"], key0
+        )
+    with pytest.raises(ValueError, match="total_seqlen_q"):
+        make_flex_key_for_new_mask_after_dispatch(
+            [[0, 2 * S]], [[0, S]], ["causal"], key0
+        )
