@@ -157,3 +157,11 @@ def test_2d_mesh_video_mask_auto_dispatch(hier, monkeypatch):
     out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
     assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5,
                  msg=f"2d video auto hier={hier}")
+
+
+def test_2d_mesh_hier_backward_hp_reduce(monkeypatch):
+    """Hier comm x fp32 wire reduce: exercises the jax.vjp-transpose
+    branch of _hp_group_cast_bwd (the hier tier has no hand-written
+    reduce plan — the custom VJP transposes the cast itself)."""
+    monkeypatch.setenv("MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE", "1")
+    _run("causal", hier=True, monkeypatch=monkeypatch, backward=True)
