@@ -161,3 +161,48 @@ def test_ragged_cast_lowers_for_tpu(monkeypatch):
         lowering_platforms=("tpu",)
     ).as_text()
     assert "ragged_all_to_all" in text
+
+
+def test_hp_cast_over_ragged_lowers_for_tpu(monkeypatch):
+    """hp_group_cast (fp32 wire reduce) over the ragged tier: the grad
+    program must cross-platform-lower with ragged_all_to_all in BOTH
+    directions (fwd cast + fp32 backward reduce) — the combination that
+    ships on TPU by default when MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE
+    is on."""
+    from magiattention_tpu.functional.dist_attn import hp_group_cast
+
+    cmm = _stages(monkeypatch=monkeypatch, ragged=True)
+    s = cmm.kv_stages[0]
+    cp = s.send_counts.shape[0]
+    if cp > len(jax.devices()):
+        pytest.skip("needs the virtual 8-device mesh")
+    shard = int(s.send_idx.max()) + 1
+    ops = _ragged_arrays(s)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:cp]), ("cp",))
+    P = jax.sharding.PartitionSpec
+
+    def loss(x, *ops):
+        y = hp_group_cast(
+            x, tuple(o[0] for o in ops), ("ragged", s.r_max), "cp",
+            shard, "bfloat16",
+        )
+        return jnp.sum(y ** 2)
+
+    def step(x, *ops):
+        return jax.grad(loss)(x, *ops)
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P("cp"),) * (1 + len(ops)),
+            out_specs=P("cp"),
+            check_vma=False,
+        )
+    )
+    x = jnp.zeros((cp * shard, 4), jnp.bfloat16)
+    text = fn.trace(x, *ops).lower(lowering_platforms=("tpu",)).as_text()
+    assert text.count("ragged_all_to_all") >= 2, "fwd + bwd ragged ops"
+    # the backward ragged op carries fp32 (the wire-reduce contract)
+    import re
+
+    assert re.search(r"ragged_all_to_all[^\n]*xf32>", text)
