@@ -31,8 +31,10 @@ HIST = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "benchmarks", "history", "true_rate.csv",
 )
-# the bench shape every ffa_fwd_* probe in true_rate.py uses
-S, HQ = 4096, 16
+# the bench shape every ffa_fwd_* probe in true_rate.py uses — MUST match
+# tpu_true_rate.py's S (moved 4096 -> 8192 in round 4); rows recorded at
+# the old shape are excluded by commit selection (one commit, one shape)
+S, HQ = 8192, 16
 PAT = re.compile(r"^ffa_fwd_bq(\d+)_bk(\d+)$")
 
 
@@ -53,6 +55,11 @@ def main() -> int:
     with open(HIST) as f:
         for row in csv.DictReader(f):
             m = PAT.match(row.get("probe", ""))
+            # shape guard: seq-8192 probes run the (8, 32) slope pair;
+            # any pre-r4 row (seq 4096, pair (24, 96)) must not enter a
+            # fit computed with S=8192 work counts
+            if row.get("len_short") not in (None, "", "8"):
+                continue
             if m and row.get("ms"):
                 c = row.get("commit", "?")
                 if c not in by_commit:
