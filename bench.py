@@ -73,6 +73,17 @@ def _promote_cached_silicon(live: dict) -> dict:
     out = dict(cached)
     out.setdefault("measured_at", "unknown")
     out["live_cpu"] = live
+    # Failure must stay visible at top level: "stale" marks a cached
+    # headline, and a worker crash keeps its error at top-level "error"
+    # (plus live_status="crashed") — otherwise a kernel regression that
+    # kills the worker is indistinguishable from a healthy chip-less run.
+    out["stale"] = True
+    if live.get("error"):
+        out["error"] = live["error"]
+        out["live_error"] = live["error"]
+        out["live_status"] = "crashed"
+    else:
+        out["live_status"] = "degraded_cpu"
     return out
 
 

@@ -202,6 +202,55 @@ def _hp_group_cast_bwd(kind, axis_name, shard_len, in_dtype, res, g):
 hp_group_cast.defvjp(_hp_group_cast_fwd, _hp_group_cast_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def hp_group_cast_all(x, ops_list, kinds, axis_name, shard_len, in_dtype):
+    """All stages of the GroupCast — local fp32 copy first, then one fp32
+    receive buffer per stage — behind ONE custom VJP.
+
+    Per-stage :func:`hp_group_cast` downcasts each reduced cotangent to the
+    input dtype independently, so JAX's implicit cotangent accumulation
+    still sums the (stages+1) dkv partials in bf16 — only approximately the
+    reference's _reduce_partial_dkv, which keeps every partial fp32 and
+    casts once (magi_attention/functional/dist_attn.py:2123; ADVICE r4).
+    Spanning local shard + all stages here lets the backward reduce each
+    stage's cotangent in fp32 on the wire, sum ALL partials (including the
+    local shard's) in fp32, and cast to the input dtype exactly once.
+    """
+    parts = [x.astype(jnp.float32)]
+    for ops, kind in zip(ops_list, kinds):
+        parts.append(_cast_any(x, ops, kind, axis_name).astype(jnp.float32))
+    return tuple(parts)
+
+
+def _hp_all_fwd(x, ops_list, kinds, axis_name, shard_len, in_dtype):
+    return (
+        hp_group_cast_all(x, ops_list, kinds, axis_name, shard_len, in_dtype),
+        ops_list,
+    )
+
+
+def _hp_all_bwd(kinds, axis_name, shard_len, in_dtype, res, g):
+    ops_list = res
+    total = g[0]  # local-shard cotangent, fp32 (part 0 is the fp32 upcast)
+    for gi, ops, kind in zip(g[1:], ops_list, kinds):
+        if kind[0] == "hier":
+            # transpose via jax.vjp of the cast itself (same trick as the
+            # ragged tier in reduce_rows) — no hand-maintained mirror plan
+            zeros = jnp.zeros((shard_len, *gi.shape[1:]), gi.dtype)
+            _, vjp_fn = jax.vjp(
+                lambda z, o=ops, kk=kind: _cast_any(z, o, kk, axis_name),
+                zeros,
+            )
+            (red,) = vjp_fn(gi)
+        else:
+            red = reduce_rows(gi, ops, kind, axis_name, shard_len)
+        total = total + red
+    return total.astype(in_dtype), None
+
+
+hp_group_cast_all.defvjp(_hp_all_fwd, _hp_all_bwd)
+
+
 def _ragged_arrays(s) -> tuple[jax.Array, ...]:
     """Whole-mesh arrays for the ragged_all_to_all GroupCast tier, derived
     from a stage's a2a plan (true per-pair sizes; the receive buffer lands
@@ -485,26 +534,44 @@ class DistAttnRuntime(DeferredTilePolicy):
                 x, tuple(o[0] for o in ops), self._kind(stage), self._axis()
             )
 
-    def _cast_kv(self, k, v, ops, stage: int = 0, hp: bool = False):
+    def _cast_kv(self, k, v, ops, stage: int = 0):
         """Fused K|V GroupCast: one collective for both tensors (the
         reference's asymmetric-KV comm fuses along head_dim the same way,
         comm_meta.py:588-591 — valid for any d_k/d_v since rows coincide).
-        ``hp=True`` routes through :func:`hp_group_cast` so the backward
-        reduce of the dkv cotangents runs in fp32 on the wire."""
-        cast = self._cast_hp if hp else self._cast
+        HP reduce does NOT route here — it uses :meth:`_hp_parts_kv`, whose
+        fused all-stage VJP is the only correct fp32 accumulation."""
         if k.dtype == v.dtype and k.shape[1] == v.shape[1]:
             kv = jnp.concatenate([k, v], axis=-1)
-            kv_r = cast(kv, ops, stage)
+            kv_r = self._cast(kv, ops, stage)
             return kv_r[..., : k.shape[-1]], kv_r[..., k.shape[-1]:]
-        return cast(k, ops, stage), cast(v, ops, stage)
+        return self._cast(k, ops, stage), self._cast(v, ops, stage)
 
-    def _cast_hp(self, x, ops, stage: int = 0):
-        """One stage's GroupCast with the fp32-wire backward reduce."""
-        with profile_scope(f"group_cast_stage{stage}"):
-            return hp_group_cast(
-                x, tuple(o[0] for o in ops), self._kind(stage),
-                self._axis(), x.shape[0], x.dtype.name,
+    def _hp_parts_kv(self, k, v, cast_ops):
+        """fp32 (local, *per-stage) parts of k and v under HP reduce.
+
+        Routes through the fused :func:`hp_group_cast_all` so the backward
+        sums EVERY dkv partial — local shard included — in fp32 and
+        downcasts once (ADVICE r4). K|V fuse into one collective when rows
+        coincide, as in :meth:`_cast_kv`."""
+        kinds = tuple(self._kind(st) for st in range(len(cast_ops)))
+        opsl = tuple(tuple(a[0] for a in ops) for ops in cast_ops)
+        with profile_scope("group_cast_hp_all"):
+            if k.dtype == v.dtype and k.shape[1] == v.shape[1]:
+                kv = jnp.concatenate([k, v], axis=-1)
+                parts = hp_group_cast_all(
+                    kv, opsl, kinds, self._axis(), kv.shape[0], kv.dtype.name
+                )
+                return (
+                    [p[..., : k.shape[-1]] for p in parts],
+                    [p[..., k.shape[-1]:] for p in parts],
+                )
+            kp = hp_group_cast_all(
+                k, opsl, kinds, self._axis(), k.shape[0], k.dtype.name
             )
+            vp = hp_group_cast_all(
+                v, opsl, kinds, self._axis(), v.shape[0], v.dtype.name
+            )
+            return list(kp), list(vp)
 
     @property
     def backend(self) -> str:
@@ -636,16 +703,17 @@ class DistAttnRuntime(DeferredTilePolicy):
             )
 
             def f(q, k, v, cast_ops, arrays):
-                # under HP reduce the receive buffers are fp32, so the
-                # local shard joins the concat upcast (its cotangent cast
-                # back is device-local — no wire cost)
-                k0 = k.astype(jnp.float32) if hp_bwd else k
-                v0 = v.astype(jnp.float32) if hp_bwd else v
-                kv_parts_k, kv_parts_v = [k0], [v0]
-                for st, ops in enumerate(cast_ops):
-                    kr, vr = self._cast_kv(k, v, ops, st, hp=hp_bwd)
-                    kv_parts_k.append(kr)
-                    kv_parts_v.append(vr)
+                if hp_bwd:
+                    # fused all-stage hp cast: receive buffers AND the
+                    # local shard are fp32, and all dkv partials sum in
+                    # fp32 with one final downcast (ADVICE r4)
+                    kv_parts_k, kv_parts_v = self._hp_parts_kv(k, v, cast_ops)
+                else:
+                    kv_parts_k, kv_parts_v = [k], [v]
+                    for st, ops in enumerate(cast_ops):
+                        kr, vr = self._cast_kv(k, v, ops, st)
+                        kv_parts_k.append(kr)
+                        kv_parts_v.append(vr)
                 k_all = jnp.concatenate(kv_parts_k, axis=0)
                 v_all = jnp.concatenate(kv_parts_v, axis=0)
                 local_arrays = tuple(a[0] for a in arrays)
@@ -683,13 +751,18 @@ class DistAttnRuntime(DeferredTilePolicy):
         def f(q, k, v, cast_ops, host_arrays, stage_arrays):
             # issue every stage's collective up front: no data dependence on
             # compute, XLA overlaps them with the host + earlier-stage kernels
-            ks, vs = [k], [v]
-            for st, ops in enumerate(cast_ops):
-                # hp: remote parts arrive fp32; _multi_ffa is
-                # dtype-polymorphic per part, so the local shard stays bf16
-                kr, vr = self._cast_kv(k, v, ops, st, hp=hp_bwd)
-                ks.append(kr)
-                vs.append(vr)
+            if hp_bwd:
+                # fused all-stage hp cast (local shard fp32 too): every dkv
+                # partial sums in fp32, one downcast — _multi_ffa is
+                # dtype-polymorphic per part, so this costs residual HBM
+                # only (the flag's documented price), not compute dtype
+                ks, vs = self._hp_parts_kv(k, v, cast_ops)
+            else:
+                ks, vs = [k], [v]
+                for st, ops in enumerate(cast_ops):
+                    kr, vr = self._cast_kv(k, v, ops, st)
+                    ks.append(kr)
+                    vs.append(vr)
             arrays_list = (tuple(a[0] for a in host_arrays),) + tuple(
                 tuple(a[0] for a in sa) for sa in stage_arrays
             )

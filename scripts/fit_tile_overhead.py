@@ -56,9 +56,10 @@ def main() -> int:
         for row in csv.DictReader(f):
             m = PAT.match(row.get("probe", ""))
             # shape guard: seq-8192 probes run the (8, 32) slope pair;
-            # any pre-r4 row (seq 4096, pair (24, 96)) must not enter a
-            # fit computed with S=8192 work counts
-            if row.get("len_short") not in (None, "", "8"):
+            # any row without the positive len_short=8 stamp (pre-r4
+            # seq-4096 rows, legacy rows missing the column) must not
+            # enter a fit computed with S=8192 work counts
+            if row.get("len_short") != "8":
                 continue
             if m and row.get("ms"):
                 c = row.get("commit", "?")

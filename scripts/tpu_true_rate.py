@@ -40,14 +40,20 @@ PEAK = 197.0
 LENGTHS = (24, 96)
 
 
-def record(probe, ms, flops):
+def record(probe, ms, flops, *, lengths):
+    """Append one slope-timed row. ``lengths`` is REQUIRED and must be the
+    scan trip counts the measurement actually used (ffa probes use
+    ATT_LENGTHS, mm probes LENGTHS) — fit_tile_overhead.py keys its shape
+    guard on len_short, so a mismatched stamp silently disqualifies the
+    row; requiring it keeps future call sites from inheriting a wrong
+    default."""
     tf = flops / (ms * 1e-3) / 1e12
     print(f"{probe}: {ms:.3f} ms {tf:.1f} TF/s ({tf/PEAK*100:.1f}% of nominal)",
           flush=True)
     append_row("true_rate", {
         "probe": probe, "ms": round(ms, 4), "tflops": round(tf, 2),
         "pct_of_nominal": round(tf / PEAK * 100, 1),
-        "len_short": LENGTHS[0], "len_long": LENGTHS[1],
+        "len_short": lengths[0], "len_long": lengths[1],
     })
     return tf
 
@@ -76,7 +82,9 @@ def main():
                 lambda x: (x @ a).astype(jnp.bfloat16), a,
                 lengths=LENGTHS, verbose=True,
             )
-            ceiling = max(ceiling, record(f"mm{n}", ms, 2 * n**3))
+            ceiling = max(
+                ceiling, record(f"mm{n}", ms, 2 * n**3, lengths=LENGTHS)
+            )
         except Exception as e:
             print(f"mm{n}: FAIL {type(e).__name__}: {str(e)[:160]}",
                   flush=True)
@@ -119,15 +127,16 @@ def main():
 
         try:
             ms = do_bench_scan_slope(ffa_fwd, qs, lengths=ATT_LENGTHS, verbose=True)
-            record(f"ffa_fwd_bq{bq}_bk{bk}", ms, fwd_flops)
+            record(f"ffa_fwd_bq{bq}_bk{bk}", ms, fwd_flops, lengths=ATT_LENGTHS)
             g = jax.grad(ffa_loss, argnums=(0, 1, 2))
             step = make_consume_all_grads_body(
                 lambda q: g(q, ks, vs), jnp.bfloat16
             )
             msb = do_bench_scan_slope(step, qs, lengths=ATT_LENGTHS, verbose=True)
-            record(f"ffa_fwdbwd_bq{bq}_bk{bk}", msb, fwd_flops * 3.5)
+            record(f"ffa_fwdbwd_bq{bq}_bk{bk}", msb, fwd_flops * 3.5,
+                   lengths=ATT_LENGTHS)
             record(f"ffa_fwdbwd_hw_bq{bq}_bk{bk}", msb,
-                   fwd_flops * 3.5 * HW_FWD_BWD_RATIO)
+                   fwd_flops * 3.5 * HW_FWD_BWD_RATIO, lengths=ATT_LENGTHS)
         except Exception as e:
             print(f"ffa bq{bq} bk{bk}: FAIL {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
@@ -148,7 +157,7 @@ def main():
 
     try:
         ms = do_bench_scan_slope(ffa_fwd_eq, qs, lengths=ATT_LENGTHS, verbose=True)
-        record("ffa_fwd_eqheads_bq512_bk512", ms, ab_flops)
+        record("ffa_fwd_eqheads_bq512_bk512", ms, ab_flops, lengths=ATT_LENGTHS)
     except Exception as e:
         print(f"ffa eqheads: FAIL {type(e).__name__}: {str(e)[:200]}",
               flush=True)
@@ -177,13 +186,13 @@ def main():
         try:
             ms = do_bench_scan_slope(bundled_fwd, qb, lengths=ATT_LENGTHS,
                                      verbose=True)
-            record("bundled_fwd", ms, ab_flops)
+            record("bundled_fwd", ms, ab_flops, lengths=ATT_LENGTHS)
             g = jax.grad(bundled_loss, argnums=(0, 1, 2))
             step = make_consume_all_grads_body(
                 lambda q: g(q, kb, vb), jnp.bfloat16
             )
             msb = do_bench_scan_slope(step, qb, lengths=ATT_LENGTHS, verbose=True)
-            record("bundled_fwdbwd", msb, ab_flops * 3.5)
+            record("bundled_fwdbwd", msb, ab_flops * 3.5, lengths=ATT_LENGTHS)
         except Exception as e:
             print(f"bundled: FAIL {type(e).__name__}: {str(e)[:200]}",
                   flush=True)
@@ -212,14 +221,14 @@ def main():
 
         ms = do_bench_scan_slope(splash_fwd, qsp, lengths=ATT_LENGTHS,
                                  verbose=True)
-        record("splash_fwd", ms, ab_flops)
+        record("splash_fwd", ms, ab_flops, lengths=ATT_LENGTHS)
         g = jax.grad(splash_loss, argnums=(0, 1, 2))
         step = make_consume_all_grads_body(
             lambda q: g(q, ksp, vsp), jnp.bfloat16
         )
         msb = do_bench_scan_slope(step, qsp, lengths=ATT_LENGTHS,
                                   verbose=True)
-        record("splash_fwdbwd", msb, ab_flops * 3.5)
+        record("splash_fwdbwd", msb, ab_flops * 3.5, lengths=ATT_LENGTHS)
     except Exception as e:
         print(f"splash: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
 
@@ -245,7 +254,8 @@ def main():
                 ms = do_bench_scan_slope(
                     ffa_fwd_p, qs, lengths=ATT_LENGTHS, verbose=True
                 )
-                record(f"ffa_fwd_gqapack_bq{bq}_bk{bk}", ms, fwd_flops)
+                record(f"ffa_fwd_gqapack_bq{bq}_bk{bk}", ms, fwd_flops,
+                       lengths=ATT_LENGTHS)
             except Exception as e:
                 print(f"gqapack bq{bq} bk{bk}: FAIL {type(e).__name__}: "
                       f"{str(e)[:200]}", flush=True)
@@ -268,7 +278,8 @@ def main():
                 lambda q: g(q, ks, vs), jnp.bfloat16
             )
             msb = do_bench_scan_slope(step, qs, lengths=ATT_LENGTHS, verbose=True)
-            record("ffa_fwdbwd_gqapackdq_bq512_bk512", msb, fwd_flops * 3.5)
+            record("ffa_fwdbwd_gqapackdq_bq512_bk512", msb, fwd_flops * 3.5,
+                   lengths=ATT_LENGTHS)
         except Exception as e:
             print(f"gqapack_dq: FAIL {type(e).__name__}: {str(e)[:200]}",
                   flush=True)
