@@ -73,6 +73,40 @@ def apply_padding(
     )
 
 
+def _compile_band(qs, qe, ks, ke, lo, hi, emit):
+    """Exact disjoint slices of the diagonal band ``lo <= c - q <= hi``
+    intersected with the rectangle ``[qs, qe) x [ks, ke)``.
+
+    Rows are split by which band edge the rectangle clips, so each region
+    is EXACTLY one of the four mask types (the types bound the band at
+    range corners — kernels/mask_utils.types_to_bands):
+
+    - left edge clipped at ks, right inside      -> CAUSAL   (hi at end)
+    - both edges inside                          -> BICAUSAL (lo, hi)
+    - both edges clipped (wide band, narrow k)   -> FULL
+    - left inside, right clipped at ke           -> INVCAUSAL (lo at start)
+    """
+    if qs >= qe or ks >= ke or lo > hi:
+        return
+    q0 = max(qs, ks - hi)       # first row with any in-range column
+    q1 = min(qe, ke - lo)       # one past the last such row
+    if q0 >= q1:
+        return
+    a = ks - lo                 # first row whose left edge clears ks
+    b = ke - 1 - hi             # first row whose right edge reaches ke-1
+    lo_edge, hi_edge = min(a, b), max(a, b)
+
+    u, v = q0, min(max(lo_edge, q0), q1)
+    emit(u, v, ks, v + hi, AttnMaskType.CAUSAL)
+    u, v = min(max(lo_edge, q0), q1), min(max(hi_edge, q0), q1)
+    if a <= b:
+        emit(u, v, u + lo, v + hi, AttnMaskType.BICAUSAL)
+    else:
+        emit(u, v, ks, ke, AttnMaskType.FULL)
+    u, v = min(max(hi_edge, q0), q1), q1
+    emit(u, v, u + lo, ke, AttnMaskType.INVCAUSAL)
+
+
 def infer_attn_mask_from_sliding_window(
     q_ranges: AttnRanges,
     k_ranges: AttnRanges,
@@ -82,16 +116,32 @@ def infer_attn_mask_from_sliding_window(
 ) -> tuple[AttnRanges, AttnRanges, list[AttnMaskType]]:
     """Compile per-segment sliding windows into slices (ref :180).
 
+    Segments may be cross-shaped — any (q_range, k_range) pair, including
+    seqlen mismatch — of any mask type. The window rides the END-aligned
+    diagonal ``c - q = k_end - q_end`` (the reference's convention, ref
+    functools.py:216-225: when q is longer than k, rows above the
+    end-aligned square are invalid and dropped), and the segment's own
+    mask type intersects as a band bound: CAUSAL caps the right edge at
+    the diagonal, INVCAUSAL floors the left edge at the START-aligned
+    diagonal ``c - q = k_start - q_start``, BICAUSAL does both.
+
     Args:
-        q_ranges/k_ranges/attn_mask_type: one entry per segment; currently
-            segments must be self-attending (q_range == k_range) with FULL or
-            CAUSAL type.
-        window_size: (left, right) window radius; -1 means unbounded on that
-            side (so (-1, -1) is FULL, (-1, 0) is CAUSAL).
-        sink_size: tokens at the start of each segment every query attends to.
+        q_ranges/k_ranges/attn_mask_type: one entry per segment.
+        window_size: (left, right) window radius around the end-aligned
+            diagonal; -1 means unbounded on that side. Fully unbounded
+            (-1, -1) with no sink is vacuous for FULL/INVCAUSAL segments:
+            the segment's own mask is returned un-windowed (the reference
+            short-circuits this case before its helper,
+            ref functools.py:370-385).
+        sink_size: keys at the start of each segment's k range that every
+            query attends to (FULL/CAUSAL segments only): rows whose
+            diagonal falls inside the sink strip attend causally within
+            it; later rows see the whole strip plus their window clipped
+            to start after it.
 
     Returns:
-        Decomposed (q_ranges, k_ranges, attn_mask_type) slice metadata.
+        Decomposed (q_ranges, k_ranges, attn_mask_type) slice metadata —
+        disjoint slices (overlap would double-count in the kernel softmax).
     """
     out_q, out_k, out_t = AttnRanges(), AttnRanges(), []
 
@@ -105,55 +155,42 @@ def infer_attn_mask_from_sliding_window(
 
     left, right = window_size
     for qr, kr, mt in zip(q_ranges, k_ranges, attn_mask_type):
-        if (qr.start, qr.end) != (kr.start, kr.end):
-            raise ValueError("sliding window needs self-attending segments")
-        if mt not in (AttnMaskType.CAUSAL, AttnMaskType.FULL):
-            raise NotImplementedError(
-                f"sliding windows over {mt} segments are not compiled"
-            )
-        s, e = qr.start, qr.end
-        causal = mt == AttnMaskType.CAUSAL or right == 0
-        lw = left if left >= 0 else e - s
-        # Disjoint decomposition (overlapping slices would double-count in
-        # the kernel's softmax): sink-region rows attend plain-causally;
-        # later rows attend the whole sink strip plus their window clipped
-        # to start after the sink.
-        snk = min(sink_size, e - s)
-        if snk > 0:
-            emit(s, s + snk, s, s + snk, AttnMaskType.CAUSAL)
-            emit(s + snk, e, s, s + snk, AttnMaskType.FULL)
-        w0 = s + snk  # first non-sink column / row
-        if causal:
-            # rows r >= w0 see cols [max(r-lw, w0), r] beyond the sink: head
-            # part is plain causal, tail is a bicausal band
-            hsplit = min(w0 + lw + 1, e)
-            emit(w0, hsplit, w0, hsplit, AttnMaskType.CAUSAL)
-            # BICAUSAL band: lo = ks - qs = -lw  => ks = qs - lw
-            #                hi = ke - qe = 0    => ke = qe
-            emit(hsplit, e, hsplit - lw, e, AttnMaskType.BICAUSAL)
+        qs, qe, ks, ke = qr.start, qr.end, kr.start, kr.end
+        qlen, klen = qe - qs, ke - ks
+        if qlen <= 0 or klen <= 0:
             continue
-        # General (left, right) window over a FULL segment (ref
-        # functools.py:180): row r sees cols [max(w0, r-lw), min(e-1, r+rw)].
-        # Split rows by which window edge is clipped by the segment so each
-        # region's band is EXACTLY reproduced by one mask type (the four
-        # types bound the band at range corners — types_to_bands):
-        #   [w0, a): left edge clipped at w0        -> CAUSAL  (hi = rw)
-        #   [a, b):  interior                       -> BICAUSAL(-lw, rw)
-        #   [b, e):  right edge clipped at e        -> INVCAUSAL (lo = -lw)
-        # When a > b (narrow segment: lw+rw >= e-w0), the middle rows have
-        # BOTH edges clipped -> FULL over [w0, e).
-        rw = right if right >= 0 else e - s
-        a = min(w0 + lw + 1, e)  # first row with unclipped left edge
-        b = max(e - rw, w0)      # first row with clipped right edge
-        m1, m2 = min(a, b), max(a, b)
-        emit(w0, m1, w0, min(m1 + rw, e), AttnMaskType.CAUSAL)
-        if a < b:
-            emit(m1, m2, m1 - lw, m2 + rw, AttnMaskType.BICAUSAL)
+        snk = min(sink_size, klen) if sink_size > 0 else 0
+        if snk and mt not in (AttnMaskType.FULL, AttnMaskType.CAUSAL):
+            raise NotImplementedError(
+                f"sink_size over {mt} segments is contradictory (the sink "
+                "strip violates the start-aligned lower bound)"
+            )
+        diag_c = ke - qe  # end-aligned diagonal offset (c - q on it)
+        # reference clamp (functools.py:227-237): -1 or >= klen-1 means
+        # unbounded; klen guarantees the edge clears the rectangle
+        lw = left if (left != -1 and left < klen - 1) else klen
+        rw = right if (right != -1 and right < klen - 1) else klen
+        lo, hi = diag_c - lw, diag_c + rw
+        if mt in (AttnMaskType.CAUSAL, AttnMaskType.BICAUSAL):
+            hi = min(hi, diag_c)
+        if mt in (AttnMaskType.INVCAUSAL, AttnMaskType.BICAUSAL):
+            lo = max(lo, ks - qs)
+        # the reference's invalid-row drop: an active window keeps only
+        # rows whose end-aligned diagonal is inside the k range. CAUSAL /
+        # BICAUSAL bands imply it already; a fully-unbounded windowless
+        # call must stay the identity on FULL/INVCAUSAL segments.
+        vacuous = left == -1 and right == -1 and snk == 0
+        qv0 = qs if vacuous else max(qs, qe - klen)
+        if snk:
+            # rows with diagonal inside the sink strip: causal within it
+            q_snk = min(qe, max(ks + snk - diag_c, qv0))
+            emit(qv0, q_snk, ks, q_snk + diag_c, AttnMaskType.CAUSAL)
+            # every later row sees the whole strip...
+            emit(q_snk, qe, ks, ks + snk, AttnMaskType.FULL)
+            # ...plus its window, clipped to start after the strip
+            _compile_band(q_snk, qe, ks + snk, ke, lo, hi, emit)
         else:
-            emit(m1, m2, w0, e, AttnMaskType.FULL)
-        # m2 - lw > w0 whenever this region is non-empty (m2 >= w0+lw+1),
-        # so the INVCAUSAL lo bound is exactly -lw — no clip needed
-        emit(m2, e, m2 - lw, e, AttnMaskType.INVCAUSAL)
+            _compile_band(qv0, qe, ks, ke, lo, hi, emit)
     return out_q, out_k, out_t
 
 
