@@ -8,13 +8,22 @@ the maximum-area rank, and runs its merged FFA program fwd+bwd on silicon
 with slope timing, recording TFLOP/s against the rank's true band area —
 the kernel-side half of the north-star claim (BASELINE.md config 5).
 
-HBM guard: the full kv buffer of a 1M causal rank shard may not fit one
-chip once the fp32 dkv outputs and head-major transposes are counted. If
-the estimate exceeds the budget, the kv buffer is clipped to its largest
-prefix that fits (band encoding keeps clipped slices exact) and the row
-records the covered fraction — rate is the metric, not total time.
+HBM guard: the full kv buffer of a 1M causal rank shard does not fit one
+chip once the fp32 dkv outputs and head-major transposes are counted, so
+the kv rows stream in k-chunks — exactly the distributed-flash schedule
+(_multi_ffa, functional/dist_attn.py): per-chunk kernels + the exact lse
+merge (functional/utils.py lse_weighted_reduce, whose contract is pinned
+by tests/test_functional/test_lse_contract.py). Band clipping to a chunk
+is exact, each kv row lands in exactly one chunk, and every chunk runs —
+so the row covers 100% of the rank's workload (r4 verdict Weak #5: the
+old largest-prefix clip covered 62% and proved nothing about the full
+program). Reported ms = sum of slope-timed chunk kernels + the measured
+merge/delta epilogue.
 
 Appends to benchmarks/history/config5_shard.csv.
+``MAGI_CONFIG5_HBM_GB`` overrides the budget (smoke: force chunking on
+small shapes). Chunk-split exactness + the merge identity are pinned by
+tests/test_support/test_config5_chunking.py.
 """
 import os
 import sys
@@ -47,7 +56,29 @@ SP = int(os.environ.get("MAGI_CONFIG5_SP", 1 << 20))
 CPN = int(os.environ.get("MAGI_CONFIG5_CP", 32))
 HQ, HK, D = 32, 8, 128  # Llama-3-8B attention geometry
 PEAK = 197.0
-HBM_BUDGET = 11 * 2**30  # leave headroom out of 16 GB for XLA scratch
+# leave headroom out of 16 GB for XLA scratch
+HBM_BUDGET = int(float(os.environ.get("MAGI_CONFIG5_HBM_GB", 11)) * 2**30)
+
+
+def split_kv_chunks(qr_np, kr_np, lo_np, hi_np, sk_full, step_k):
+    """Split band slices into kv chunks of ``step_k`` rows.
+
+    Returns ``[(c0, c1, qr, kr(shifted), lo(shifted), hi(shifted)), ...]``.
+    Clipping a band slice to a k interval is exact (per-row bounds
+    intersect), every kv row lands in exactly one chunk, and the summed
+    chunk areas equal the original area — pinned by
+    tests/test_support/test_config5_chunking.py, which also checks the
+    streamed partials lse-merge to the whole-kv kernel output."""
+    bounds = list(range(0, sk_full, step_k)) + [sk_full]
+    bounds = sorted(set(min(b, sk_full) for b in bounds))
+    chunks = []
+    for c0, c1 in zip(bounds[:-1], bounds[1:]):
+        keep = (kr_np[:, 1] > c0) & (kr_np[:, 0] < c1)
+        kr_c = np.clip(kr_np[keep], c0, c1) - c0
+        chunks.append((
+            c0, c1, qr_np[keep], kr_c, lo_np[keep] - c0, hi_np[keep] - c0,
+        ))
+    return chunks
 
 
 def band_area(qr, kr, lo, hi) -> int:
@@ -104,77 +135,130 @@ def main() -> int:
         dkv = sk * HK * D * 4 * 2           # fp32 dk + dv
         return q_side + kv_side + dkv
 
-    sk = sk_full
     qr_np = np.asarray(a.q_ranges, np.int32)
     kr_np = np.asarray(a.k_ranges, np.int32)
     lo_np = np.asarray(a.d_lo, np.int32)
     hi_np = np.asarray(a.d_hi, np.int32)
-    frac = 1.0
-    if mem_bytes(sk_full) > HBM_BUDGET:
-        # clip kv to the largest prefix that fits; bands stay exact
-        sk = sk_full
-        while mem_bytes(sk) > HBM_BUDGET:
-            sk = int(sk * 0.85) // 128 * 128
-        keep = kr_np[:, 0] < sk
-        qr_np, lo_np, hi_np = qr_np[keep], lo_np[keep], hi_np[keep]
-        kr_np = np.minimum(kr_np[keep], sk)
-        area_cov = band_area(qr_np, kr_np, lo_np, hi_np)
-        frac = area_cov / areas[r]
-        print(f"HBM clip: sk {sk_full} -> {sk} (area coverage {frac:.2%})",
-              flush=True)
 
-    area = band_area(qr_np, kr_np, lo_np, hi_np)
+    # chunked-kv streaming: smallest chunk count whose per-chunk buffers
+    # fit the budget. Every kv row lands in exactly one chunk -> coverage
+    # is 1.0 by construction; per-chunk bands are exact clips.
+    n_chunks = 1
+    while mem_bytes(-(-sk_full // n_chunks)) > HBM_BUDGET:
+        n_chunks += 1
+        if n_chunks > 64:
+            raise SystemExit(
+                "HBM budget too small for the q-side buffers alone — "
+                "raise MAGI_CONFIG5_HBM_GB"
+            )
+    per = -(-sk_full // n_chunks)
+    step_k = max(128, -(-per // 128) * 128) if n_chunks > 1 else sk_full
+    chunks = split_kv_chunks(qr_np, kr_np, lo_np, hi_np, sk_full, step_k)
+    chunk_areas = [band_area(q_, k_, lo_, hi_)
+                   for _, _, q_, k_, lo_, hi_ in chunks]
+    area = int(sum(chunk_areas))
+    assert area == areas[r], (area, areas[r])  # clipping must be exact
+    print(f"kv streaming: {n_chunks} chunk(s) of <= {step_k} rows "
+          f"(full-rank coverage by construction)", flush=True)
+
     if "--plan-only" in sys.argv:
-        print(f"plan-only: area={area:.3e} slices={len(qr_np)} ok",
-              flush=True)
+        print(f"plan-only: area={area:.3e} chunks={n_chunks} "
+              f"slices={[len(c[2]) for c in chunks]} ok", flush=True)
         return 0
-    bq, bk = default_blocks(sq, sk)
-    plan = get_ffa_plan(qr_np, kr_np, lo_np, hi_np, sq, sk, bq, bk)
-    params = FFAParams(
-        num_work=plan.num_work, num_work_t=plan.num_work_t,
-        num_q_tiles=plan.num_q_tiles, num_k_tiles=plan.num_k_tiles,
-        block_q=bq, block_k=bk, softmax_scale=float(D) ** -0.5,
-        softcap=0.0, group=HQ // HK, interpret=_should_interpret(),
-    )
-    arrays = tuple(jnp.asarray(x) for x in plan_arrays(plan))
 
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((sq, HQ, D)), jnp.bfloat16)
-    k = jnp.asarray(rng.standard_normal((sk, HK, D)), jnp.bfloat16)
-    v = jnp.asarray(rng.standard_normal((sk, HK, D)), jnp.bfloat16)
     w = jnp.asarray(rng.standard_normal((sq, HQ, D)), jnp.bfloat16)
-
     fwd_flops = 4 * area * D * HQ
 
-    def fwd(qc):
-        o, _ = ffa_attn_with_plan(qc, k, v, arrays, params)
-        return o.astype(jnp.bfloat16)
+    ms_fwd_total = 0.0
+    ms_fwdbwd_total = 0.0
+    outs, lses = [], []
+    for ci, (c0, c1, qr_c, kr_c, lo_c, hi_c) in enumerate(chunks):
+        sk_c = c1 - c0
+        bq, bk = default_blocks(sq, sk_c)
+        plan = get_ffa_plan(qr_c, kr_c, lo_c, hi_c, sq, sk_c, bq, bk)
+        params = FFAParams(
+            num_work=plan.num_work, num_work_t=plan.num_work_t,
+            num_q_tiles=plan.num_q_tiles, num_k_tiles=plan.num_k_tiles,
+            block_q=bq, block_k=bk, softmax_scale=float(D) ** -0.5,
+            softcap=0.0, group=HQ // HK, interpret=_should_interpret(),
+        )
+        arrays = tuple(jnp.asarray(x) for x in plan_arrays(plan))
+        crng = np.random.default_rng(1000 + ci)
+        k = jnp.asarray(crng.standard_normal((sk_c, HK, D)), jnp.bfloat16)
+        v = jnp.asarray(crng.standard_normal((sk_c, HK, D)), jnp.bfloat16)
 
-    ms = do_bench_scan_slope(fwd, q, lengths=(4, 12))
-    tf_fwd = fwd_flops / (ms * 1e-3) / 1e12
-    print(f"config5 rank-shard fwd: {ms:.1f} ms {tf_fwd:.1f} TF/s "
-          f"({tf_fwd/PEAK*100:.1f}% nominal)", flush=True)
+        def fwd(qc, k=k, v=v, arrays=arrays, params=params):
+            o, lse = ffa_attn_with_plan(qc, k, v, arrays, params)
+            return o.astype(jnp.bfloat16), lse
+
+        ms = do_bench_scan_slope(
+            lambda qc: fwd(qc)[0], q, lengths=(4, 12)
+        )
+        ms_fwd_total += ms
+        o_c, lse_c = jax.jit(fwd)(q)
+        outs.append(np.asarray(o_c, np.float32))
+        lses.append(np.asarray(lse_c, np.float32))
+
+        def loss(qc, kc, vc, arrays=arrays, params=params):
+            # per-chunk grad: identical kernel launches and shapes as the
+            # final-lse distributed-flash backward (_multi_ffa_bwd runs
+            # the same dq/dkv kernels per part), so the timing transfers
+            o, _ = ffa_attn_with_plan(qc, kc, vc, arrays, params)
+            return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+
+        g = jax.grad(loss, argnums=(0, 1, 2))
+        step = make_consume_all_grads_body(
+            lambda qc, k=k, v=v, g=g: g(qc, k, v), jnp.bfloat16
+        )
+        msb = do_bench_scan_slope(step, q, lengths=(3, 9))
+        ms_fwdbwd_total += msb
+        tf_c = 4 * chunk_areas[ci] * D * HQ / (ms * 1e-3) / 1e12
+        print(f"  chunk {ci} [{c0}:{c1}): fwd {ms:.1f} ms {tf_c:.1f} TF/s"
+              f", fwd+bwd {msb:.1f} ms", flush=True)
+
+    # merge/delta epilogue: the exact lse merge of the streamed partials
+    # + the backward's delta rowsum — measured, not assumed negligible
+    from magiattention_tpu.functional.utils import lse_weighted_reduce
+
+    ost = jnp.asarray(np.stack(outs))
+    lst = jnp.asarray(np.stack(lses))
+
+    def epilogue(ost):
+        # carry-invariant body (scan requires it) that CONSUMES out, lse
+        # and delta — the 1e-30 dependence is the repo's anti-DCE idiom
+        # (make_consume_all_grads_body): without it XLA dead-code-
+        # eliminates the delta rowsum and lse from the timed program
+        out, lse = lse_weighted_reduce(ost, lst)
+        delta = jnp.sum(
+            out.astype(jnp.float32) * w.astype(jnp.float32), axis=-1
+        )
+        touch = (jnp.sum(lse) + jnp.sum(delta)) * 1e-30
+        return ost + (out.astype(jnp.float32) + touch)[None] * 1e-30
+
+    ms_merge = do_bench_scan_slope(epilogue, ost, lengths=(4, 12))
+    print(f"  merge/delta epilogue: {ms_merge:.2f} ms", flush=True)
+
+    ms_fwd_total += ms_merge
+    ms_fwdbwd_total += ms_merge
+    tf_fwd = fwd_flops / (ms_fwd_total * 1e-3) / 1e12
+    print(f"config5 rank-shard fwd (100% coverage): {ms_fwd_total:.1f} ms "
+          f"{tf_fwd:.1f} TF/s ({tf_fwd/PEAK*100:.1f}% nominal)", flush=True)
     append_row("config5_shard", {
-        "phase": "fwd", "rank": r, "sq": sq, "sk": sk,
-        "area_frac": round(frac, 4), "ms": round(ms, 2),
-        "tflops": round(tf_fwd, 2),
+        "phase": "fwd", "rank": r, "sq": sq, "sk": sk_full,
+        "area_frac": 1.0, "n_chunks": n_chunks,
+        "ms": round(ms_fwd_total, 2), "tflops": round(tf_fwd, 2),
         "pct_nominal": round(tf_fwd / PEAK * 100, 1),
     })
-
-    def loss(qc, kc, vc):
-        o, _ = ffa_attn_with_plan(qc, kc, vc, arrays, params)
-        return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
-
-    g = jax.grad(loss, argnums=(0, 1, 2))
-    step = make_consume_all_grads_body(lambda qc: g(qc, k, v), jnp.bfloat16)
-    msb = do_bench_scan_slope(step, q, lengths=(3, 9))
-    tf = fwd_flops * 3.5 / (msb * 1e-3) / 1e12
-    print(f"config5 rank-shard fwd+bwd: {msb:.1f} ms {tf:.1f} TF/s "
+    tf = fwd_flops * 3.5 / (ms_fwdbwd_total * 1e-3) / 1e12
+    print(f"config5 rank-shard fwd+bwd (100% coverage): "
+          f"{ms_fwdbwd_total:.1f} ms {tf:.1f} TF/s "
           f"({tf/PEAK*100:.1f}% nominal)", flush=True)
     append_row("config5_shard", {
-        "phase": "fwdbwd", "rank": r, "sq": sq, "sk": sk,
-        "area_frac": round(frac, 4), "ms": round(msb, 2),
-        "tflops": round(tf, 2),
+        "phase": "fwdbwd", "rank": r, "sq": sq, "sk": sk_full,
+        "area_frac": 1.0, "n_chunks": n_chunks,
+        "ms": round(ms_fwdbwd_total, 2), "tflops": round(tf, 2),
         "pct_nominal": round(tf / PEAK * 100, 1),
     })
     return 0
