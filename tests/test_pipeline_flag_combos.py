@@ -1,0 +1,198 @@
+"""Multi-flag e2e combinations of the round-4-wired features.
+
+The reference's pipeline suite sweeps its legal flag matrix across world
+sizes (ref tests/test_pipeline.py:378 + flag_generator); the repo's
+FlagCombGenerator covers the kernel/backend axes on the flat 1D mesh.
+This file adds the distributed-feature axes the r4 verdict flagged as
+never combined in one e2e case (Next #9): hierarchical comm x HP reduce
+x overlap staging, qo-comm x HP x uneven shard, the ragged tier x fp32
+wire at full-pipeline TPU lowering, and sink+window masks through the
+CP engine.
+
+Illegal combos are intentionally absent: qo-comm forces overlap degree 1
+(config.py DynamicAttnConfig), and the ragged tier cannot EXECUTE on
+XLA:CPU (lowering gate only, like _dryrun_ragged_tier_lowering).
+"""
+
+import os
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from magiattention_tpu import DispatchConfig, DistAttnConfig, OverlapConfig
+from magiattention_tpu.api import (
+    calc_attn,
+    clear_cache,
+    dispatch,
+    magi_attn_flex_key,
+    undispatch,
+)
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.testing import assert_close, ref_attn
+
+S = 256
+H, HK, D = 2, 1, 32
+CHUNK = 16
+CAUSAL = 1
+
+
+def _mask(qr, kr, tm):
+    return AttnMask.from_ranges(
+        AttnRanges.from_ranges(qr), AttnRanges.from_ranges(kr),
+        [AttnMaskType.from_int_type(t) for t in tm],
+        total_seqlen_q=S, total_seqlen_k=S,
+    ).mask_array
+
+
+def _run_case(key, qr, kr, tm, seed=0, atol=1e-3):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    mask = _mask(qr, kr, tm)
+
+    def fwd(q, k, v):
+        od, _ = calc_attn(
+            dispatch(q, key), dispatch(k, key, role="kv"),
+            dispatch(v, key, role="kv"), key,
+        )
+        return undispatch(od, key)
+
+    out = jax.jit(fwd)(q, k, v)
+    out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=atol, rtol=atol, norm_rtol=3e-4,
+                 msg="out")
+
+    g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(fwd(q, k, v) * w), argnums=(0, 1, 2)
+    ))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            ref_attn(q, k, v, mask, compute_dtype=jnp.float32)[0] * w
+        ), argnums=(0, 1, 2),
+    )(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), g, g_ref):
+        assert_close(a, b, atol=atol, rtol=atol, norm_rtol=3e-4, msg=name)
+
+
+@pytest.mark.slow
+def test_hier_x_hp_x_overlap(monkeypatch):
+    """Hierarchical 2-phase cast x fp32 wire reduce x 2-stage overlap on
+    a 2D (dcn x ici) mesh — all three distributed knobs in ONE program."""
+    monkeypatch.setenv("MAGI_ATTENTION_HIERARCHICAL_COMM", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE", "1")
+    clear_cache()
+    devs = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    mesh = Mesh(devs, axis_names=("dcn", "ici"))
+    qr, kr, tm = [[0, 128], [128, S]], [[0, 128], [128, S]], [CAUSAL, CAUSAL]
+    key = magi_attn_flex_key(
+        qr, kr, tm, S, S, mesh=mesh, cp_axis=("dcn", "ici"),
+        chunk_size=CHUNK,
+        dist_attn_config=DistAttnConfig(
+            overlap_config=OverlapConfig(degree=2)
+        ),
+    )
+    _run_case(key, qr, kr, tm, seed=1)
+    clear_cache()
+
+
+def test_qo_comm_x_hp_x_uneven(monkeypatch):
+    """Dynamic qo-comm solver x fp32 fwd AND bwd wire x uneven shards —
+    the dynamic runtime's three independent knobs composed."""
+    monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_FWD_HIGH_PRECISION_REDUCE", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE", "1")
+    clear_cache()
+    from magiattention_tpu.api.magi_attn_interface import _mgr
+    from magiattention_tpu.functional.dynamic_dist_attn import (
+        DynamicDistAttnRuntime,
+    )
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("cp",))
+    qr, kr, tm = [[0, S]], [[0, S]], [CAUSAL]
+    key = magi_attn_flex_key(
+        qr, kr, tm, S, S, mesh=mesh, cp_axis="cp", chunk_size=CHUNK,
+        dist_attn_config=DistAttnConfig(
+            dispatch_config=DispatchConfig(uneven_shard=True)
+        ),
+    )
+    assert isinstance(_mgr(key).runtime, DynamicDistAttnRuntime)
+    _run_case(key, qr, kr, tm, seed=2)
+    clear_cache()
+
+
+@pytest.mark.slow
+def test_ragged_x_hp_tpu_lowering(monkeypatch):
+    """Ragged grpcoll tier x fp32 wire reduce at FULL-pipeline altitude:
+    the loss gradient lowered for TPU must contain ragged_all_to_all in
+    both directions (fwd cast + bwd reduce). XLA:CPU cannot execute the
+    op, so this is a cross-platform lowering gate, the same strategy as
+    __graft_entry__._dryrun_ragged_tier_lowering."""
+    monkeypatch.setenv("MAGI_ATTENTION_RAGGED_GRPCOLL", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE", "1")
+    clear_cache()
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("cp",))
+    qr, kr, tm = [[0, S]], [[0, S]], [CAUSAL]
+    key = magi_attn_flex_key(
+        qr, kr, tm, S, S, mesh=mesh, cp_axis="cp", chunk_size=CHUNK,
+    )
+    # bf16 inputs: ONLY then does an f32 ragged op prove the HP wire
+    # (with fp32 inputs every collective is f32 and the check is vacuous)
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+
+    def loss(q, k, v):
+        od, _ = calc_attn(
+            dispatch(q, key), dispatch(k, key, role="kv"),
+            dispatch(v, key, role="kv"), key,
+        )
+        return jnp.sum(undispatch(od, key).astype(jnp.float32)
+                       * w.astype(jnp.float32))
+
+    text = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).trace(
+        q, k, v
+    ).lower(lowering_platforms=("tpu",)).as_text()
+    ragged_lines = [ln for ln in text.splitlines()
+                    if "ragged_all_to_all" in ln]
+    assert len(ragged_lines) >= 2, (
+        f"expected fwd+bwd ragged ops, found {len(ragged_lines)}"
+    )
+    # fwd cast stays on the bf16 wire; the hp backward reduce moves fp32
+    assert any("bf16" in ln for ln in ragged_lines), \
+        "no bf16 ragged op — fwd wire dtype changed"
+    assert any("f32" in ln for ln in ragged_lines), \
+        "no fp32 ragged op — HP wire not engaged"
+    clear_cache()
+
+
+def test_sink_window_mask_through_cp(monkeypatch):
+    """Sliding-window + sink compiled metadata through the CP engine with
+    RANGE_MERGE on — the mask-compiler features composed with the
+    distributed path (not just the single-device kernel)."""
+    monkeypatch.setenv("MAGI_ATTENTION_RANGE_MERGE", "1")
+    clear_cache()
+    from magiattention_tpu.api import infer_attn_mask_from_sliding_window
+
+    oq, ok, ot = infer_attn_mask_from_sliding_window(
+        AttnRanges.from_ranges([[0, S]]), AttnRanges.from_ranges([[0, S]]),
+        [AttnMaskType.FULL], (32, 16), sink_size=8,
+    )
+    qr = [[r.start, r.end] for r in oq]
+    kr = [[r.start, r.end] for r in ok]
+    tm = [t.to_int_type() for t in ot]
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("cp",))
+    key = magi_attn_flex_key(
+        qr, kr, tm, S, S, mesh=mesh, cp_axis="cp", chunk_size=CHUNK,
+    )
+    _run_case(key, qr, kr, tm, seed=4)
+    clear_cache()
