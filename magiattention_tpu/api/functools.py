@@ -24,8 +24,24 @@ def infer_attn_mask_from_cu_seqlens(
     cu_seqlens_q: Sequence[int],
     cu_seqlens_k: Sequence[int] | None = None,
     causal: bool = True,
+    window_size: tuple[int, int] = (-1, -1),
+    global_window_size: int = 0,
 ) -> tuple[AttnRanges, AttnRanges, list[AttnMaskType]]:
-    """Varlen (packed segments) mask -> slice metadata."""
+    """Varlen (packed segments) mask -> slice metadata (ref :335).
+
+    With the default ``window_size=(-1, -1)`` each segment gets a plain
+    FULL/CAUSAL mask. A bounded window compiles per-segment sliding
+    windows (requires ``causal=False``, as in the reference :387-390 —
+    a causal window is expressed as ``(left, 0)``), optionally with
+    ``global_window_size`` leading key tokens per segment that every
+    query attends to. Global-token semantics follow the reference
+    (:399-470): a query at in-segment position ``i`` sees global keys
+    ``[0, min(G, i + right_window + 1))`` — early queries see fewer, so
+    no information leaks past the right window boundary — and its
+    sliding window runs over the remaining keys (end-aligned; queries
+    above the end-aligned square keep their right-window reach into the
+    local keys, the reference's part-3 blocks).
+    """
     q_ranges = AttnRanges.from_cu_seqlens(list(cu_seqlens_q))
     k_ranges = (
         AttnRanges.from_cu_seqlens(list(cu_seqlens_k))
@@ -34,8 +50,68 @@ def infer_attn_mask_from_cu_seqlens(
     )
     if len(q_ranges) != len(k_ranges):
         raise ValueError("cu_seqlens_q and cu_seqlens_k imply different counts")
-    t = AttnMaskType.CAUSAL if causal else AttnMaskType.FULL
-    return q_ranges, k_ranges, [t] * len(q_ranges)
+    if global_window_size < 0:
+        raise ValueError("global_window_size must be non-negative")
+    if tuple(window_size) == (-1, -1):
+        # global_window_size is only effective with a bounded window —
+        # the reference's documented contract (ref :360-361); with no
+        # window every query already reaches the leading keys its mask
+        # type allows
+        t = AttnMaskType.CAUSAL if causal else AttnMaskType.FULL
+        return q_ranges, k_ranges, [t] * len(q_ranges)
+    if causal:
+        raise ValueError(
+            "causal must be False when window_size is not (-1, -1) — "
+            "express a causal window as (left, 0) (ref functools.py:387)"
+        )
+    if global_window_size == 0:
+        # pure windows: one batched compile over all segments
+        return infer_attn_mask_from_sliding_window(
+            q_ranges, k_ranges,
+            [AttnMaskType.FULL] * len(q_ranges), window_size,
+        )
+
+    left, right = window_size
+    out_q, out_k, out_t = AttnRanges(), AttnRanges(), []
+
+    def emit(qs, qe, ks, ke, t):
+        if qs < qe and ks < ke:
+            from ..common.range import AttnRange
+
+            out_q.append(AttnRange(qs, qe))
+            out_k.append(AttnRange(ks, ke))
+            out_t.append(t)
+
+    for qr, kr in zip(q_ranges, k_ranges):
+        qs, qe, ks, ke = qr.start, qr.end, kr.start, kr.end
+        qlen, klen = qe - qs, ke - ks
+        if qlen <= 0 or klen <= 0:
+            continue
+        g = min(global_window_size, klen)
+        # global part: constrained early queries (CAUSAL over the strip,
+        # right edge at i + rw), then FULL over all g global keys
+        rw_eff = right if (right != -1 and right < klen - 1) else klen
+        constrained = min(max(0, g - rw_eff - 1), qlen)
+        emit(qs, qs + constrained, ks, ks + constrained + rw_eff,
+             AttnMaskType.CAUSAL)
+        emit(qs + constrained, qe, ks, ks + g, AttnMaskType.FULL)
+        # local part: the window band over the non-global keys, with NO
+        # invalid-row drop — the band's natural validity keeps every
+        # query whose right window reaches a local key (parts 2 + 3 of
+        # the reference composition in one exact decomposition). The
+        # clamp uses the FULL key length: the reference's part-3 blocks
+        # apply the literal right window to the dropped rows (its
+        # oracle: tests/test_api/test_functools.py:133-185), so a
+        # local-length re-clamp would overreach there.
+        lklen = klen - g
+        if lklen <= 0:
+            continue
+        lw_l = left if (left != -1 and left < klen - 1) else klen
+        rw_l = right if (right != -1 and right < klen - 1) else klen
+        diag_c = ke - qe
+        _compile_band(qs, qe, ks + g, ke, diag_c - lw_l, diag_c + rw_l,
+                      emit)
+    return out_q, out_k, out_t
 
 
 def infer_varlen_mask_from_batch(

@@ -200,6 +200,8 @@ def magi_attn_varlen_key(
     cu_seqlens_k: Sequence[int] | None = None,
     *,
     causal: bool = False,
+    window_size: tuple[int, int] = (-1, -1),
+    global_window_size: int = 0,
     mesh: Mesh,
     cp_axis: str = "cp",
     head_axis: str | None = None,
@@ -207,9 +209,12 @@ def magi_attn_varlen_key(
     dist_attn_config: DistAttnConfig | None = None,
 ) -> DistAttnRuntimeKey:
     """Varlen (cu_seqlens) convenience wrapper (ref :160; causal defaults
-    False, matching the reference and the re-key variant)."""
+    False, matching the reference and the re-key variant). ``window_size``
+    / ``global_window_size`` compile per-segment sliding windows with
+    global (sink) tokens (ref :169,317)."""
     q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
-        cu_seqlens_q, cu_seqlens_k, causal
+        cu_seqlens_q, cu_seqlens_k, causal,
+        window_size=window_size, global_window_size=global_window_size,
     )
     return magi_attn_flex_key(
         q_ranges,
@@ -283,20 +288,16 @@ def make_varlen_key_for_new_mask_after_dispatch(
     key_for_dispatch: DistAttnRuntimeKey,
     causal: bool = False,
     window_size: tuple[int, int] = (-1, -1),
+    global_window_size: int = 0,
     dist_attn_config: DistAttnConfig | None = None,
 ) -> DistAttnRuntimeKey:
-    """Varlen convenience form of re-keying (ref :1172)."""
+    """Varlen convenience form of re-keying (ref :1172) — ONE compile
+    path with :func:`magi_attn_varlen_key`, so a model created with
+    windows + global sinks re-keys to the identical mask."""
     q_ranges, k_ranges, types = infer_attn_mask_from_cu_seqlens(
-        cu_seqlens_q, cu_seqlens_k, causal
+        cu_seqlens_q, cu_seqlens_k, causal,
+        window_size=window_size, global_window_size=global_window_size,
     )
-    if window_size != (-1, -1):
-        if causal:
-            raise ValueError("window_size requires causal=False (ref :1203)")
-        from .functools import infer_attn_mask_from_sliding_window
-
-        q_ranges, k_ranges, types = infer_attn_mask_from_sliding_window(
-            q_ranges, k_ranges, types, window_size
-        )
     return make_flex_key_for_new_mask_after_dispatch(
         q_ranges, k_ranges, types, key_for_dispatch, dist_attn_config
     )

@@ -1,0 +1,150 @@
+"""cu_seqlens + sliding window + global tokens (ref api/functools.py:335,
+tests/test_api/test_functools.py sliding-window/global sweeps).
+
+The oracle below re-implements the reference's DOCUMENTED semantics from
+scratch: per segment, a query at in-segment position i sees global keys
+[0, min(G, i + W_r_eff + 1)) and local keys within the end-aligned
+window [d - W_l, d + W_r] (d = i + local_klen - qlen), with dropped rows
+(d < 0) keeping their right-window reach into the local keys when G > 0
+and attending nothing local when G == 0 (the reference composition only
+adds its part-3 blocks on the global path).
+"""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.api.functools import infer_attn_mask_from_cu_seqlens
+from magiattention_tpu.common.mask import AttnMask
+
+
+def oracle(cu, window, g_size, total):
+    lw, rw = window
+    m = np.zeros((total, total), bool)
+    for s0, s1 in zip(cu[:-1], cu[1:]):
+        seqlen = s1 - s0
+        if seqlen <= 0:
+            continue
+        g = min(g_size, seqlen)
+        lw_e = lw if (lw != -1 and lw < seqlen - 1) else seqlen
+        rw_e = rw if (rw != -1 and rw < seqlen - 1) else seqlen
+        lklen = seqlen - g
+        for i in range(seqlen):
+            # global strip with the leakage constraint
+            vis = min(g, i + rw_e + 1)
+            if vis > 0:
+                m[s0 + i, s0:s0 + vis] = True
+            if lklen <= 0:
+                continue
+            d = i + (lklen - seqlen)  # end-aligned local diagonal
+            if g == 0 and d < 0:
+                continue  # no global path -> dropped rows attend nothing
+            lo = max(0, d - lw_e)
+            hi = min(lklen - 1, d + rw_e)
+            if lo <= hi:
+                m[s0 + i, s0 + g + lo:s0 + g + hi + 1] = True
+    return m
+
+
+def compiled(cu, window, g_size, total):
+    oq, ok, ot = infer_attn_mask_from_cu_seqlens(
+        cu, causal=False, window_size=window, global_window_size=g_size,
+    )
+    got = np.asarray(AttnMask.from_ranges(
+        oq, ok, ot, total_seqlen_q=total, total_seqlen_k=total
+    ).mask_array)
+    # disjointness: every slice triple must add without overlap
+    count = np.zeros((total, total), np.int32)
+    from magiattention_tpu.common.ranges import AttnRanges
+
+    for q, k, t in zip(oq, ok, ot):
+        count += np.asarray(AttnMask.from_ranges(
+            AttnRanges.from_ranges([[q.start, q.end]]),
+            AttnRanges.from_ranges([[k.start, k.end]]),
+            [t], total_seqlen_q=total, total_seqlen_k=total,
+        ).mask_array).astype(np.int32)
+    assert count.max() <= 1, "overlapping slices"
+    return got
+
+
+CU_CASES = [
+    [0, 30],
+    [0, 10, 20, 40, 60, 100],
+    [0, 5, 50, 53, 80],
+    [0, 15, 30, 45, 60],
+]
+
+
+@pytest.mark.parametrize("cu", CU_CASES, ids=lambda c: f"segs{len(c)-1}")
+def test_window_sweep_matches_oracle(cu):
+    total = cu[-1]
+    for lw in range(-1, 9):
+        for rw in range(-1, 9):
+            got = compiled(cu, (lw, rw), 0, total)
+            want = oracle(cu, (lw, rw), 0, total)
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"cu={cu} window=({lw},{rw})"
+            )
+
+
+@pytest.mark.parametrize("cu", CU_CASES, ids=lambda c: f"segs{len(c)-1}")
+def test_global_window_sweep_matches_oracle(cu):
+    total = cu[-1]
+    for g in (1, 2, 4, 7, 15, 50):
+        for lw in (-1, 0, 2, 5):
+            for rw in (-1, 0, 2, 5):
+                got = compiled(cu, (lw, rw), g, total)
+                want = oracle(cu, (lw, rw), g, total)
+                np.testing.assert_array_equal(
+                    got, want,
+                    err_msg=f"cu={cu} window=({lw},{rw}) G={g}",
+                )
+
+
+def test_plain_paths_unchanged():
+    """(-1,-1) keeps the historical plain varlen behavior."""
+    oq, ok, ot = infer_attn_mask_from_cu_seqlens([0, 8, 20], causal=True)
+    assert [(r.start, r.end) for r in oq] == [(0, 8), (8, 20)]
+    assert all(t.name == "CAUSAL" for t in ot)
+
+
+def test_causal_with_window_raises():
+    with pytest.raises(ValueError, match="causal must be False"):
+        infer_attn_mask_from_cu_seqlens(
+            [0, 16], causal=True, window_size=(4, 0)
+        )
+
+
+def test_varlen_key_with_window_end_to_end():
+    """window + global through magi_attn_varlen_key and the CP engine."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from magiattention_tpu.api import (
+        calc_attn, dispatch, magi_attn_varlen_key, undispatch,
+    )
+    from magiattention_tpu.testing import assert_close, ref_attn
+
+    S = 256
+    cu = [0, 96, 256]
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("cp",))
+    key = magi_attn_varlen_key(
+        cu, causal=False, window_size=(24, 0), global_window_size=8,
+        mesh=mesh, chunk_size=16,
+    )
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.standard_normal((S, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, 1, 32)), jnp.float32)
+
+    def fwd(q, k, v):
+        od, _ = calc_attn(
+            dispatch(q, key), dispatch(k, key, role="kv"),
+            dispatch(v, key, role="kv"), key,
+        )
+        return undispatch(od, key)
+
+    out = jax.jit(fwd)(q, k, v)
+    mask = oracle(cu, (24, 0), 8, S)
+    out_ref, _ = ref_attn(q, k, v, mask, compute_dtype=jnp.float32)
+    assert_close(out, out_ref, atol=1e-4, rtol=1e-4, norm_rtol=3e-5)
