@@ -100,6 +100,40 @@ def main() -> None:
     )
     print("fa3_func_with_sink(ssh):", out3.shape)
 
+    # 5. the varlen front-end does the compile for you: cu_seqlens +
+    # window + global tokens in one call (ref api/functools.py:335 —
+    # global keys obey the leakage rule: query i sees at most
+    # min(G, i + right + 1) of them)
+    from magiattention_tpu.api import magi_attn_varlen_key
+
+    key_v = magi_attn_varlen_key(
+        [0, S // 2, S], causal=False,
+        window_size=(48, 0), global_window_size=8,
+        mesh=mesh, chunk_size=64,
+    )
+    od, _ = calc_attn(
+        dispatch(q, key_v), dispatch(k, key_v, role="kv"),
+        dispatch(v, key_v, role="kv"), key_v,
+    )
+    print("varlen window+global out:", undispatch(od, key_v).shape)
+
+    # 6. cross-shaped windows: q and k ranges may differ (chunked-prefill
+    # style — the window rides the END-aligned diagonal; queries above
+    # the end-aligned square are invalid and dropped, ref :216-225)
+    cq, ck, ct = infer_attn_mask_from_sliding_window(
+        AttnRanges.from_ranges([[0, S]]),
+        AttnRanges.from_ranges([[0, S // 2]]),
+        [AttnMaskType.FULL], window_size=(32, 8),
+    )
+    kc = jnp.asarray(rng.standard_normal((S // 2, H, D)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((S // 2, H, D)), jnp.bfloat16)
+    out4, _ = flex_flash_attn_func(
+        q, kc, vc, cq, ck,
+        np.asarray([t.to_int_type() for t in ct], np.int32),
+    )
+    print(f"cross-shaped window (sq={S}, sk={S // 2}): {len(cq)} slices, "
+          f"out {out4.shape}")
+
 
 if __name__ == "__main__":
     main()
