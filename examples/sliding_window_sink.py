@@ -37,7 +37,8 @@ import numpy as np
 from jax.sharding import Mesh
 
 from magiattention_tpu.api import (
-    calc_attn, dispatch, magi_attn_flex_key, undispatch,
+    calc_attn, dispatch, magi_attn_flex_key, magi_attn_varlen_key,
+    undispatch,
 )
 from magiattention_tpu.api.functools import (
     infer_attn_mask_from_sliding_window,
@@ -104,8 +105,6 @@ def main() -> None:
     # window + global tokens in one call (ref api/functools.py:335 —
     # global keys obey the leakage rule: query i sees at most
     # min(G, i + right + 1) of them)
-    from magiattention_tpu.api import magi_attn_varlen_key
-
     key_v = magi_attn_varlen_key(
         [0, S // 2, S], causal=False,
         window_size=(48, 0), global_window_size=8,

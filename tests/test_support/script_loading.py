@@ -1,0 +1,10 @@
+"""Shared loader for repo scripts under test (scripts/ has no package)."""
+
+import importlib.util
+
+
+def load_script(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -13,25 +13,21 @@ probe's 100%-coverage claim rests on:
 import numpy as np
 import pytest
 
-
-def _import_script():
-    import importlib.util
-    import os
-
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)
-        ))), "scripts", "tpu_config5_shard.py",
-    )
-    spec = importlib.util.spec_from_file_location("tpu_config5_shard", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+from tests.test_support.script_loading import load_script
 
 
 @pytest.fixture(scope="module")
 def shard_mod():
-    return _import_script()
+    import os
+
+    return load_script(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))), "scripts", "tpu_config5_shard.py",
+        ),
+        "tpu_config5_shard",
+    )
 
 
 BANDS = [

@@ -1,0 +1,154 @@
+"""The window-day post-processing tools must work BEFORE a window lands.
+
+A chip window is minutes long and rare; the scripts that turn its CSV
+rows into decisions (fit_tile_overhead's least-squares, bench.py's
+cached-silicon promotion) run unattended afterwards. These tests pin
+them on synthetic data so a tooling bug cannot waste the next window.
+"""
+
+import csv
+import json
+import os
+
+import numpy as np
+
+from tests.test_support.script_loading import load_script
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+class TestFitTileOverhead:
+    def _write_rows(self, path, rows):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        keys = sorted({k for r in rows for k in r})
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            w.writerows(rows)
+
+    def test_recovers_planted_overhead(self, tmp_path, monkeypatch):
+        """Synthesize ms(bq,bk) = alpha*W*bq*bk + beta*W rows at the real
+        seq-8192 work counts; the fit must recover beta/alpha."""
+        fit = load_script(
+            os.path.join(ROOT, "scripts", "fit_tile_overhead.py"),
+            "fit_tile_overhead",
+        )
+        from magiattention_tpu.kernels.mask_utils import types_to_bands
+        from magiattention_tpu.kernels.tile_policy import count_ffa_work
+
+        S = fit.S
+        qr = np.array([[0, S]], np.int32)
+        kr = np.array([[0, S]], np.int32)
+        lo, hi = types_to_bands(qr, kr, np.array([1], np.int32))
+        alpha, beta = 2.5e-9, 1.5e-3  # OVERHEAD_ELEMS = 600k
+        rows = []
+        for bq, bk in [(256, 512), (512, 512), (512, 1024), (1024, 1024)]:
+            w = count_ffa_work(qr, kr, lo, hi, S, S, bq, bk)
+            rows.append({
+                "probe": f"ffa_fwd_bq{bq}_bk{bk}",
+                "ms": alpha * w * bq * bk + beta * w,
+                "commit": "abc1234", "len_short": "8", "len_long": "32",
+            })
+        # contamination rows the guards must reject: wrong shape stamp,
+        # missing stamp, different commit with fewer tilings
+        rows.append({"probe": "ffa_fwd_bq512_bk512", "ms": 999.0,
+                     "commit": "abc1234", "len_short": "24",
+                     "len_long": "96"})
+        rows.append({"probe": "ffa_fwd_bq256_bk512", "ms": 123.0,
+                     "commit": "abc1234", "len_short": "",
+                     "len_long": ""})
+        rows.append({"probe": "ffa_fwd_bq512_bk512", "ms": 5.0,
+                     "commit": "zzz9999", "len_short": "8",
+                     "len_long": "32"})
+        hist = tmp_path / "true_rate.csv"
+        self._write_rows(str(hist), rows)
+        monkeypatch.setattr(fit, "HIST", str(hist))
+
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = fit.main()
+        out = buf.getvalue()
+        assert rc == 0, out
+        assert "abc1234 (4 tilings)" in out
+        got = float(out.split("OVERHEAD_ELEMS ~= ")[1]
+                    .split()[0].replace(",", ""))
+        want = beta / alpha
+        assert abs(got - want) / want < 1e-6, (got, want)
+
+    def test_refuses_degenerate_fit(self, tmp_path, monkeypatch):
+        """Noise implying negative overhead must refuse, not recommend."""
+        fit = load_script(
+            os.path.join(ROOT, "scripts", "fit_tile_overhead.py"),
+            "fit_tile_overhead",
+        )
+        from magiattention_tpu.kernels.mask_utils import types_to_bands
+        from magiattention_tpu.kernels.tile_policy import count_ffa_work
+
+        S = fit.S
+        qr = np.array([[0, S]], np.int32)
+        kr = np.array([[0, S]], np.int32)
+        lo, hi = types_to_bands(qr, kr, np.array([1], np.int32))
+        alpha, beta = 1e-7, -1e-3  # beta < 0: negative implied overhead
+        rows = []
+        for bq, bk in [(256, 512), (512, 512), (1024, 1024)]:
+            w = count_ffa_work(qr, kr, lo, hi, S, S, bq, bk)
+            rows.append({
+                "probe": f"ffa_fwd_bq{bq}_bk{bk}",
+                "ms": alpha * w * bq * bk + beta * w,
+                "commit": "abc1234", "len_short": "8", "len_long": "32",
+            })
+        hist = tmp_path / "true_rate.csv"
+        self._write_rows(str(hist), rows)
+        monkeypatch.setattr(fit, "HIST", str(hist))
+
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = fit.main()
+        assert rc == 1
+        assert "degenerate fit" in buf.getvalue()  # THE guard, not rc=1
+
+
+class TestBenchPromotion:
+    def _bench(self, tmp_path, monkeypatch, cached):
+        bench = load_script(os.path.join(ROOT, "bench.py"), "bench_module")
+        cache = tmp_path / ".bench_last_tpu.json"
+        if cached is not None:
+            cache.write_text(json.dumps(cached))
+        monkeypatch.setattr(bench, "_CACHE_PATH", str(cache))
+        return bench
+
+    CACHED = {"metric": "m", "value": 42.0, "unit": "TFLOP/s",
+              "vs_baseline": 0.4, "measured_at": "2026-07-30T00:00:00Z"}
+
+    def test_degraded_cpu_marked_stale(self, tmp_path, monkeypatch):
+        bench = self._bench(tmp_path, monkeypatch, self.CACHED)
+        out = bench._promote_cached_silicon(
+            {"metric": "m", "value": 0.0, "backend": "cpu"}
+        )
+        assert out["value"] == 42.0
+        assert out["stale"] is True
+        assert out["live_status"] == "degraded_cpu"
+        assert "error" not in out
+
+    def test_crash_keeps_error_at_top_level(self, tmp_path, monkeypatch):
+        bench = self._bench(tmp_path, monkeypatch, self.CACHED)
+        out = bench._promote_cached_silicon(
+            {"metric": "m", "value": 0.0, "error": "worker died"}
+        )
+        assert out["value"] == 42.0
+        assert out["stale"] is True
+        assert out["error"] == "worker died"
+        assert out["live_status"] == "crashed"
+
+    def test_no_cache_passthrough(self, tmp_path, monkeypatch):
+        bench = self._bench(tmp_path, monkeypatch, None)
+        live = {"metric": "m", "value": 0.0, "error": "boom"}
+        assert bench._promote_cached_silicon(dict(live)) == live
