@@ -52,17 +52,11 @@ def compiled(cu, window, g_size, total):
     got = np.asarray(AttnMask.from_ranges(
         oq, ok, ot, total_seqlen_q=total, total_seqlen_k=total
     ).mask_array)
-    # disjointness: every slice triple must add without overlap
-    count = np.zeros((total, total), np.int32)
-    from magiattention_tpu.common.ranges import AttnRanges
+    from tests.test_api.test_sliding_window_general import (
+        assert_slices_disjoint,
+    )
 
-    for q, k, t in zip(oq, ok, ot):
-        count += np.asarray(AttnMask.from_ranges(
-            AttnRanges.from_ranges([[q.start, q.end]]),
-            AttnRanges.from_ranges([[k.start, k.end]]),
-            [t], total_seqlen_q=total, total_seqlen_k=total,
-        ).mask_array).astype(np.int32)
-    assert count.max() <= 1, "overlapping slices"
+    assert_slices_disjoint(oq, ok, ot, total, total)
     return got
 
 

@@ -14,6 +14,21 @@ from magiattention_tpu.common.mask import AttnMask
 from magiattention_tpu.common.ranges import AttnRanges
 
 
+def assert_slices_disjoint(oq, ok, ot, tq, tk):
+    """Overlapping slices would double-count keys in the kernel softmax —
+    the invariant every compiler output must satisfy."""
+    count = np.zeros((tq, tk), np.int32)
+    for q, k, t in zip(oq, ok, ot):
+        count += np.asarray(
+            AttnMask.from_ranges(
+                AttnRanges.from_ranges([[q.start, q.end]]),
+                AttnRanges.from_ranges([[k.start, k.end]]),
+                [t], total_seqlen_q=tq, total_seqlen_k=tk,
+            ).mask_array
+        ).astype(np.int32)
+    assert count.max() <= 1, "overlapping slices"
+
+
 def brute_window_mask(segs, window, sink, total, causal):
     """Row-by-row construction of the expected mask."""
     m = np.zeros((total, total), bool)
@@ -68,23 +83,11 @@ def test_window_compilation_matches_bruteforce(segs, window, sink, causal):
 
 
 def test_slices_are_disjoint():
-    """Overlapping slices would double-count keys in the kernel softmax."""
     oq, ok, ot = infer_attn_mask_from_sliding_window(
         AttnRanges.from_ranges([[0, 96]]), AttnRanges.from_ranges([[0, 96]]),
         [AttnMaskType.FULL], (8, 4), sink_size=6,
     )
-    total = 96
-    count = np.zeros((total, total), np.int32)
-    for q, k, t in zip(oq, ok, ot):
-        one = np.asarray(
-            AttnMask.from_ranges(
-                AttnRanges.from_ranges([[q.start, q.end]]),
-                AttnRanges.from_ranges([[k.start, k.end]]),
-                [t], total_seqlen_q=total, total_seqlen_k=total,
-            ).mask_array
-        )
-        count += one.astype(np.int32)
-    assert count.max() <= 1
+    assert_slices_disjoint(oq, ok, ot, 96, 96)
 
 
 def brute_cross_window(seg_q, seg_k, mt, window, total_q, total_k):
@@ -157,17 +160,7 @@ def test_cross_window_matches_bruteforce(seg_q, seg_k, mt, window):
     )
     want = brute_cross_window(seg_q, seg_k, mt, window, total_q, total_k)
     np.testing.assert_array_equal(got, want)
-    # disjointness: overlap would double-count in the kernel softmax
-    count = np.zeros((total_q, total_k), np.int32)
-    for q, k, t in zip(oq, ok, ot):
-        count += np.asarray(
-            AttnMask.from_ranges(
-                AttnRanges.from_ranges([[q.start, q.end]]),
-                AttnRanges.from_ranges([[k.start, k.end]]),
-                [t], total_seqlen_q=total_q, total_seqlen_k=total_k,
-            ).mask_array
-        ).astype(np.int32)
-    assert count.max() <= 1
+    assert_slices_disjoint(oq, ok, ot, total_q, total_k)
 
 
 def test_cross_window_exhaustive_small_grids():

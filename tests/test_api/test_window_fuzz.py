@@ -1,0 +1,112 @@
+"""Randomized property sweep of the window compilers (host-only, fast).
+
+The exhaustive small-grid sweeps (test_sliding_window_general /
+test_cu_seqlens_window) pin exact semantics at tiny sizes; this fuzzer
+drives the same oracles at random larger shapes — segment lists, cross
+shapes, windows, sinks, global sizes — where off-by-one tile/clip bugs
+that only trigger past some size would hide. Pure mask comparison (no
+jit), so hundreds of cases stay cheap.
+"""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.api.functools import (
+    infer_attn_mask_from_cu_seqlens,
+    infer_attn_mask_from_sliding_window,
+)
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.mask import AttnMask
+from magiattention_tpu.common.ranges import AttnRanges
+
+from tests.test_api.test_sliding_window_general import (
+    assert_slices_disjoint as _assert_disjoint,
+    brute_cross_window,
+)
+from tests.test_api.test_cu_seqlens_window import oracle as cu_oracle
+
+
+def _mask_of(oq, ok, ot, tq, tk):
+    return np.asarray(AttnMask.from_ranges(
+        oq, ok, ot, total_seqlen_q=tq, total_seqlen_k=tk
+    ).mask_array)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_fuzz_cross_window(seed):
+    rng = np.random.default_rng(seed)
+    tq = int(rng.integers(16, 400))
+    tk = int(rng.integers(16, 400))
+    qs = int(rng.integers(0, tq // 2))
+    qe = int(rng.integers(qs + 1, tq + 1))
+    ks = int(rng.integers(0, tk // 2))
+    ke = int(rng.integers(ks + 1, tk + 1))
+    mt = AttnMaskType.from_int_type(int(rng.integers(0, 4)))
+    lw = int(rng.integers(-1, max(2, (ke - ks))))
+    rw = int(rng.integers(-1, max(2, (ke - ks))))
+    oq, ok, ot = infer_attn_mask_from_sliding_window(
+        AttnRanges.from_ranges([[qs, qe]]),
+        AttnRanges.from_ranges([[ks, ke]]),
+        [mt], (lw, rw),
+    )
+    got = _mask_of(oq, ok, ot, tq, tk)
+    want = brute_cross_window((qs, qe), (ks, ke), mt, (lw, rw), tq, tk)
+    np.testing.assert_array_equal(
+        got, want, err_msg=f"seed={seed} q=[{qs},{qe}) k=[{ks},{ke}) "
+                           f"{mt} ({lw},{rw})"
+    )
+    _assert_disjoint(oq, ok, ot, tq, tk)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_fuzz_cu_seqlens_window_global(seed):
+    rng = np.random.default_rng(1000 + seed)
+    n_seg = int(rng.integers(1, 6))
+    lens = rng.integers(1, 120, n_seg)
+    cu = [0] + list(np.cumsum(lens).astype(int))
+    total = cu[-1]
+    lw = int(rng.integers(-1, 40))
+    rw = int(rng.integers(-1, 40))
+    g = int(rng.integers(0, 30))
+    if (lw, rw) == (-1, -1):
+        lw = 0  # vacuous window covered elsewhere; keep the fuzz on-path
+    oq, ok, ot = infer_attn_mask_from_cu_seqlens(
+        cu, causal=False, window_size=(lw, rw), global_window_size=g,
+    )
+    got = _mask_of(oq, ok, ot, total, total)
+    want = cu_oracle(cu, (lw, rw), g, total)
+    np.testing.assert_array_equal(
+        got, want, err_msg=f"seed={seed} cu={cu} ({lw},{rw}) G={g}"
+    )
+    _assert_disjoint(oq, ok, ot, total, total)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_fuzz_window_sink_square(seed):
+    """Random square segments x window x sink vs the documented brute."""
+    from tests.test_api.test_sliding_window_general import brute_window_mask
+
+    rng = np.random.default_rng(2000 + seed)
+    n_seg = int(rng.integers(1, 4))
+    lens = rng.integers(4, 150, n_seg)
+    bounds = [0] + list(np.cumsum(lens).astype(int))
+    segs = list(zip(bounds[:-1], bounds[1:]))
+    total = bounds[-1]
+    lw = int(rng.integers(-1, 60))
+    rw = int(rng.integers(0, 60))
+    sink = int(rng.integers(0, 20))
+    causal = bool(rng.integers(0, 2))
+    t = AttnMaskType.CAUSAL if causal else AttnMaskType.FULL
+    oq, ok, ot = infer_attn_mask_from_sliding_window(
+        AttnRanges.from_ranges([list(s) for s in segs]),
+        AttnRanges.from_ranges([list(s) for s in segs]),
+        [t] * n_seg, (lw, rw), sink_size=sink,
+    )
+    got = _mask_of(oq, ok, ot, total, total)
+    want = brute_window_mask(segs, (lw, rw), sink, total, causal)
+    np.testing.assert_array_equal(
+        got, want,
+        err_msg=f"seed={seed} segs={segs} ({lw},{rw}) sink={sink} "
+                f"causal={causal}",
+    )
+    _assert_disjoint(oq, ok, ot, total, total)
