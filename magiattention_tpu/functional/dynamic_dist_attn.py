@@ -33,7 +33,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm.primitives import cast_rows, reduce_rows
 from ..env import general as env_general
-from ..env import kernel as env_kernel
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,

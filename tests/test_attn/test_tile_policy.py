@@ -1,7 +1,6 @@
 """Auto tile selection (kernels/tile_policy.py — ref tile-table analogue)."""
 
 import numpy as np
-import pytest
 
 from magiattention_tpu.kernels.mask_utils import types_to_bands
 from magiattention_tpu.kernels.tile_policy import (

@@ -1,6 +1,5 @@
 """AttnRectangle geometry tests."""
 
-import numpy as np
 
 from magiattention_tpu.common.enum import AttnMaskType
 from magiattention_tpu.common.mask import slice_mask_block

@@ -1,7 +1,5 @@
 """Dispatch affinity tests (ref dispatch_solver.py:373-520)."""
 
-import numpy as np
-import pytest
 
 from magiattention_tpu.common.range import AttnRange
 from magiattention_tpu.common.ranges import AttnRanges

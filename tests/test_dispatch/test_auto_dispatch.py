@@ -7,7 +7,6 @@ SEQUENTIAL's area imbalance would dominate wall-clock.
 """
 
 import numpy as np
-import pytest
 
 from magiattention_tpu.api.functools import infer_attn_mask_from_sliding_window
 from magiattention_tpu.common.enum import AttnMaskType, DispatchAlgType

@@ -28,7 +28,6 @@ from magiattention_tpu.api import (
     magi_attn_flex_key,
     undispatch,
 )
-from magiattention_tpu.common.ranges import AttnRanges
 from magiattention_tpu.config import DistAttnConfig, OverlapConfig
 from magiattention_tpu.meta import (
     make_attn_meta_from_dispatch_meta,

@@ -174,8 +174,6 @@ def test_dispatch_balance():
 
 def test_dynamic_overlap_degree():
     # degree=None -> OverlapSolver sweeps degrees; plans must stay exact
-    from magiattention_tpu.common.enum import AttnOverlapMode
-
     recon, expected, comm_meta, calc_meta, _ = reconstruct_global_mask(
         "causal", 4, overlap_degree=None
     )
