@@ -36,7 +36,7 @@ def is_bwd_high_precision_reduce_enable() -> bool:
     env/comm.py:123). Doubles backward comm volume; removes the cp-way
     low-precision summation error.
 
-    Consumed by functional/dist_attn.py (hp_group_cast custom-VJP wire) and
+    Consumed by functional/dist_attn.py (hp_group_cast_all fused custom-VJP wire) and
     functional/dynamic_dist_attn.py (_dyn_bwd partial dtype choice).
     """
     return _get_bool("MAGI_ATTENTION_BWD_HIGH_PRECISION_REDUCE")
