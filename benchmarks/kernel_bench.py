@@ -113,24 +113,32 @@ def main() -> int:
 
     from magiattention_tpu.benchmarking.bench import (
         do_bench_scan_slope,
-        make_consume_all_grads_body,
+        make_consume_all_grads_kv_body,
+        make_fwd_kv_body,
     )
     from magiattention_tpu.benchmarking.perf_report import (
         HW_FWD_BWD_RATIO,
+        PEAK_TFLOPS,
         append_row,
+        credible_floor_ms,
         history_report,
     )
     from magiattention_tpu.kernels.ffa import ffa_attn
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
     HQ, HK, D = args.heads, args.kv_heads, args.head_dim
-    peak = 197.0
+    peak = PEAK_TFLOPS
 
-    def scan_time(body, init, reps=2):
+    def scan_time(body, init, flops=None, reps=2):
         # slope timing (cancels the tunnel's ~170 ms fixed per-launch cost
         # — benchmarks/history/chip_calibration.csv); falls back to a short
-        # plain scan off-TPU inside the helper
-        return do_bench_scan_slope(body, init, reps=reps, verbose=True)
+        # plain scan off-TPU inside the helper. flops sets the physical
+        # floor: a slope implying > 1.05x the chip ceiling is an
+        # under-cancelled pair and falls back to the long-scan upper bound
+        floor = None if flops is None else credible_floor_ms(flops)
+        return do_bench_scan_slope(
+            body, init, reps=reps, verbose=True, min_credible_ms=floor
+        )
 
     rows = []
     rng = np.random.default_rng(0)
@@ -144,28 +152,40 @@ def main() -> int:
                 qr, kr, tm, area = build_mask(name, s)
                 flops = 4 * area * D * HQ
 
-                dt = scan_time(
-                    lambda qq: ffa_attn(qq, k, v, qr, kr, tm)[0].astype(dtype),
-                    q0,
+                # k/v/w ride the scan carry (jit arguments): closed-over
+                # jax.Arrays lower as HLO constants, and at 131k rows the
+                # ~1 GB payload breaks the tunnel's remote-compile helper
+                # (2026-08-01 config5 window postmortem)
+                fwd_body = make_fwd_kv_body(
+                    lambda qq, kk, vv, qr=qr, kr=kr, tm=tm:
+                        ffa_attn(qq, kk, vv, qr, kr, tm)[0],
+                    dtype,
                 )
+                dt = scan_time(fwd_body, (q0, k, v), flops=flops)
                 row = {
                     "mask": name, "seqlen": s,
                     "fwd_ms": round(dt, 3),
                     "fwd_tflops": round(flops / (dt * 1e-3) / 1e12, 2),
                     "fwd_mfu": round(flops / (dt * 1e-3) / 1e12 / peak, 4),
                 }
+                if row["fwd_mfu"] > 1.05:
+                    # even the long-scan upper bound is unphysical; flag
+                    # per PHASE so a bad fwd doesn't bar the row's valid
+                    # fwdbwd columns from setting report baselines
+                    row["suspect_fwd"] = 1
                 if args.backward:
-                    def loss(qq, kk, vv):
+                    def loss(qq, kk, vv, ww, qr=qr, kr=kr, tm=tm):
                         o, _ = ffa_attn(qq, kk, vv, qr, kr, tm)
                         return jnp.sum(
-                            o.astype(jnp.float32) * w.astype(jnp.float32)
+                            o.astype(jnp.float32) * ww.astype(jnp.float32)
                         )
 
                     g = jax.grad(loss, argnums=(0, 1, 2))
-                    bwd_body = make_consume_all_grads_body(
-                        lambda qq, k=k, v=v: g(qq, k, v), dtype
-                    )
-                    dtb = scan_time(bwd_body, q0)
+                    bwd_body = make_consume_all_grads_kv_body(g, dtype)
+                    dtb = scan_time(bwd_body, (q0, k, v, w),
+                                    flops=flops * 3.5)
+                    if flops * 3.5 / (dtb * 1e-3) / 1e12 > peak * 1.05:
+                        row["suspect_fwdbwd"] = 1
                     row["fwdbwd_ms"] = round(dtb, 3)
                     row["fwdbwd_tflops"] = round(
                         flops * 3.5 / (dtb * 1e-3) / 1e12, 2

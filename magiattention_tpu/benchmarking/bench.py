@@ -105,6 +105,7 @@ def do_bench_scan_slope(
     lengths: tuple[int, int] = (24, 96),
     reps: int = 3,
     verbose: bool = False,
+    min_credible_ms: float | None = None,
 ) -> float:
     """Overhead-robust per-iteration ms of ``body``.
 
@@ -125,6 +126,12 @@ def do_bench_scan_slope(
     Off-TPU there is no launch cost to cancel and interpret-mode steps
     cost seconds, so a short single scan is the right measurement — the
     backend dispatch lives HERE so every harness gets it.
+
+    ``min_credible_ms``: physical floor on the per-step time (the caller
+    knows its flop count and the chip ceiling; the slope does not). A
+    slope BELOW the floor is an under-cancelled pair (observed 2026-08-01:
+    250 TF/s reported on a 197 TF/s chip) and triggers the same fallback
+    as the noise guard — the long-scan per-step time, a true upper bound.
     """
     if jax.default_backend() != "tpu":
         return do_bench_scan(body, carry0, length=2, reps=reps)
@@ -148,13 +155,24 @@ def do_bench_scan_slope(
         slopes.append((tl - ts) / (long_ - short))
     slope = float(np.median(slopes))
     ok = 0.0 < slope <= t_long_best
+    floor_hit = (
+        ok and min_credible_ms is not None and slope < min_credible_ms
+    )
+    if floor_hit:
+        ok = False
     if verbose:
+        guard = "" if ok else (
+            f" -> CREDIBILITY FLOOR ({min_credible_ms:.3f} ms): slope is "
+            f"above the chip ceiling — under-cancelled pair, fallback to "
+            f"len{long_} upper bound {t_long_best:.3f}"
+            if floor_hit else
+            f" -> NOISE GUARD: fallback to len{long_} upper bound "
+            f"{t_long_best:.3f}"
+        )
         print(
             f"  [slope timing incl compile {time.perf_counter()-t0:.0f}s: "
             f"per-rep slopes {[round(s, 3) for s in slopes]} ms/step"
-            + ("" if ok else
-               f" -> NOISE GUARD: fallback to len{long_} upper bound "
-               f"{t_long_best:.3f}"),
+            + guard,
             flush=True,
         )
     # noise guard: non-positive slope (long ran FASTER than short) or slope
@@ -182,7 +200,10 @@ def make_consume_all_grads_body(grad_fn, dtype):
     pallas_call that XLA dead-code-eliminates when unused, silently
     dropping ~60% of the backward from the measured program (caught on
     silicon when fwd+bwd timed faster than fwd alone). Every fwd+bwd
-    timing harness must build its body through this ONE helper.
+    timing harness must build its body through this helper or its
+    sibling `make_consume_all_grads_kv_body` — use THIS one only when
+    the closed-over operands are small (closure capture lowers them as
+    HLO constants); at >~100 MB switch to the kv/carry variant.
 
     ``grad_fn(q) -> (dq, dk, dv)``; dk/dv enter the carry as a 1e-30-scaled
     scalar — numerically invisible, but a real data dependence XLA cannot
@@ -194,6 +215,51 @@ def make_consume_all_grads_body(grad_fn, dtype):
         dq, dk, dv = grad_fn(q)
         touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
         return (q + 1e-3 * dq.astype(dtype) + touch.astype(dtype)).astype(dtype)
+
+    return body
+
+
+def make_consume_all_grads_kv_body(grad_fn, dtype):
+    """`make_consume_all_grads_body` variant whose carry is ``(q, k, v)``.
+
+    A jitted body that merely *closes over* a jax.Array lowers it as an
+    HLO constant; at GB scale that payload breaks the tunnel's
+    remote-compile helper (2026-08-01 config5 window: 2.15 GB of captured
+    kv chunks -> "Broken pipe" from the compile endpoint, the whole probe
+    lost). Carrying k/v through the scan makes them jit ARGUMENTS — zero
+    per-step cost (XLA aliases unmodified carry leaves) and a
+    constant-free executable. Same anti-DCE contract as the q-only
+    helper: ``grad_fn(q, k, v, *aux) -> (dq, dk, dv)``, all three
+    consumed; any further carry leaves (e.g. a large cotangent seed w)
+    ride through unchanged so they too stay arguments.
+    """
+    import jax.numpy as jnp
+
+    def body(carry):
+        q, k, v, *aux = carry
+        dq, dk, dv = grad_fn(q, k, v, *aux)
+        touch = (jnp.sum(dk) + jnp.sum(dv)) * 1e-30
+        qn = (
+            q + 1e-3 * dq.astype(dtype) + touch.astype(dtype)
+        ).astype(dtype)
+        return (qn, k, v, *aux)
+
+    return body
+
+
+def make_fwd_kv_body(fwd_fn, dtype):
+    """Forward-only timing body with a ``(q, k, v, *aux)`` carry.
+
+    Same no-captured-constants rationale as
+    `make_consume_all_grads_kv_body`: ``fwd_fn(q, k, v, *aux) -> out``
+    (out must be q-shaped) is called with every operand as a scan-carry
+    leaf so GB-scale k/v lower as jit arguments, and the out->q chain
+    provides the data dependence that defeats tunnel memoization.
+    """
+
+    def body(carry):
+        q, k, v, *aux = carry
+        return (fwd_fn(q, k, v, *aux).astype(dtype), k, v, *aux)
 
     return body
 

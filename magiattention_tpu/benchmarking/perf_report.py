@@ -34,6 +34,18 @@ HISTORY_DIR = os.path.join(
 # bwd: dq pass 3 matmuls + dkv pass 4 vs fwd's 2)
 HW_FWD_BWD_RATIO = 4.5 / 3.5
 
+# nominal bf16 peak of the one attached chip (TPU v5 lite), TFLOP/s — the
+# ONE definition every harness's MFU figures and credibility floors use
+# (silicon measures ~105% of it on a 4096^3 matmul: true_rate.csv mm4096)
+PEAK_TFLOPS = 197.0
+
+
+def credible_floor_ms(flops: float, slack: float = 1.05) -> float:
+    """Physical lower bound on a measurement of ``flops`` of matmul work:
+    time implying more than ``slack``x the chip ceiling is unphysical
+    (pass as ``do_bench_scan_slope(min_credible_ms=...)``)."""
+    return flops / (PEAK_TFLOPS * slack) / 1e9
+
 
 def _git_rev() -> str:
     try:
@@ -100,8 +112,17 @@ def history_report(name: str, key_cols: list[str], value_col: str) -> str:
         return ""
     with open(path, newline="") as f:
         rows = list(csv.DictReader(f))
+    phase = value_col.split("_")[0]  # fwd_tflops -> fwd, fwdbwd_ms -> fwdbwd
     by_key: dict[tuple, list[dict]] = {}
     for r in rows:
+        if r.get("suspect") or r.get(f"suspect_{phase}"):
+            # harness marked the measurement unphysical (rate above the
+            # chip ceiling even at the long-scan upper bound) — keep the
+            # raw row in the CSV but never let it set a baseline. Plain
+            # "suspect" taints the whole row; "suspect_<phase>" taints
+            # only that phase's columns, so a bad fwd slope doesn't
+            # suppress the same row's valid fwdbwd measurement.
+            continue
         by_key.setdefault(tuple(r.get(k, "") for k in key_cols), []).append(r)
     lines = [
         f"# {name}: latest {value_col} per ({', '.join(key_cols)}) "

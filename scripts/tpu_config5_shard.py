@@ -48,14 +48,19 @@ import numpy as np
 
 from magiattention_tpu.benchmarking.bench import (  # noqa: E402
     do_bench_scan_slope,
-    make_consume_all_grads_body,
+    make_consume_all_grads_kv_body,
+    make_fwd_kv_body,
 )
-from magiattention_tpu.benchmarking.perf_report import append_row  # noqa: E402
+from magiattention_tpu.benchmarking.perf_report import (  # noqa: E402
+    PEAK_TFLOPS,
+    append_row,
+    credible_floor_ms,
+)
 
 SP = int(os.environ.get("MAGI_CONFIG5_SP", 1 << 20))
 CPN = int(os.environ.get("MAGI_CONFIG5_CP", 32))
 HQ, HK, D = 32, 8, 128  # Llama-3-8B attention geometry
-PEAK = 197.0
+PEAK = PEAK_TFLOPS
 # leave headroom out of 16 GB for XLA scratch
 HBM_BUDGET = int(float(os.environ.get("MAGI_CONFIG5_HBM_GB", 11)) * 2**30)
 
@@ -238,6 +243,7 @@ def main() -> int:
 
     ms_fwd_total = 0.0
     ms_fwdbwd_total = 0.0
+    suspect_fwd = suspect_bwd = False
     outs, lses = [], []
     for ci, (c0, c1, qr_c, kr_c, lo_c, hi_c) in enumerate(chunks):
         sk_c = c1 - c0
@@ -254,30 +260,44 @@ def main() -> int:
         k = jnp.asarray(crng.standard_normal((sk_c, HK, D)), jnp.bfloat16)
         v = jnp.asarray(crng.standard_normal((sk_c, HK, D)), jnp.bfloat16)
 
-        def fwd(qc, k=k, v=v, arrays=arrays, params=params):
-            o, lse = ffa_attn_with_plan(qc, k, v, arrays, params)
+        # k/v/w must ride the scan CARRY (jit arguments), never a closure:
+        # a closed-over jax.Array lowers as an HLO constant, and this
+        # loop's kv chunks total ~2 GB — a payload the tunnel's remote-
+        # compile helper answers with "Broken pipe" (2026-08-01 window,
+        # fixed here); the ~268 MB cotangent seed w gets the same route
+        def fwd(qc, kc, vc, arrays=arrays, params=params):
+            o, lse = ffa_attn_with_plan(qc, kc, vc, arrays, params)
             return o.astype(jnp.bfloat16), lse
 
+        chunk_flops = 4 * chunk_areas[ci] * D * HQ
         ms = do_bench_scan_slope(
-            lambda qc: fwd(qc)[0], q, lengths=(4, 12)
+            make_fwd_kv_body(lambda qc, kc, vc: fwd(qc, kc, vc)[0],
+                             jnp.bfloat16),
+            (q, k, v), lengths=(4, 12),
+            min_credible_ms=credible_floor_ms(chunk_flops),
         )
+        if ms < credible_floor_ms(chunk_flops):
+            suspect_fwd = True  # even the long-scan bound is unphysical
         ms_fwd_total += ms
-        o_c, lse_c = jax.jit(fwd)(q)
+        o_c, lse_c = jax.jit(fwd)(q, k, v)
         outs.append(np.asarray(o_c, np.float32))
         lses.append(np.asarray(lse_c, np.float32))
 
-        def loss(qc, kc, vc, arrays=arrays, params=params):
+        def loss(qc, kc, vc, ww, arrays=arrays, params=params):
             # per-chunk grad: identical kernel launches and shapes as the
             # final-lse distributed-flash backward (_multi_ffa_bwd runs
             # the same dq/dkv kernels per part), so the timing transfers
             o, _ = ffa_attn_with_plan(qc, kc, vc, arrays, params)
-            return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+            return jnp.sum(o.astype(jnp.float32) * ww.astype(jnp.float32))
 
         g = jax.grad(loss, argnums=(0, 1, 2))
-        step = make_consume_all_grads_body(
-            lambda qc, k=k, v=v, g=g: g(qc, k, v), jnp.bfloat16
+        step = make_consume_all_grads_kv_body(g, jnp.bfloat16)
+        msb = do_bench_scan_slope(
+            step, (q, k, v, w), lengths=(3, 9),
+            min_credible_ms=credible_floor_ms(chunk_flops * 3.5),
         )
-        msb = do_bench_scan_slope(step, q, lengths=(3, 9))
+        if msb < credible_floor_ms(chunk_flops * 3.5):
+            suspect_bwd = True
         ms_fwdbwd_total += msb
         tf_c = 4 * chunk_areas[ci] * D * HQ / (ms * 1e-3) / 1e12
         print(f"  chunk {ci} [{c0}:{c1}): fwd {ms:.1f} ms {tf_c:.1f} TF/s"
@@ -290,19 +310,24 @@ def main() -> int:
     ost = jnp.asarray(np.stack(outs))
     lst = jnp.asarray(np.stack(lses))
 
-    def epilogue(ost):
+    def epilogue(carry):
         # carry-invariant body (scan requires it) that CONSUMES out, lse
         # and delta — the 1e-30 dependence is the repo's anti-DCE idiom
         # (make_consume_all_grads_body): without it XLA dead-code-
-        # eliminates the delta rowsum and lse from the timed program
+        # eliminates the delta rowsum and lse from the timed program.
+        # lst/w ride the carry for the same no-captured-constants reason
+        # as the chunk bodies above.
+        ost, lst, wc = carry
         out, lse = lse_weighted_reduce(ost, lst)
         delta = jnp.sum(
-            out.astype(jnp.float32) * w.astype(jnp.float32), axis=-1
+            out.astype(jnp.float32) * wc.astype(jnp.float32), axis=-1
         )
         touch = (jnp.sum(lse) + jnp.sum(delta)) * 1e-30
-        return ost + (out.astype(jnp.float32) + touch)[None] * 1e-30
+        return (
+            ost + (out.astype(jnp.float32) + touch)[None] * 1e-30, lst, wc
+        )
 
-    ms_merge = do_bench_scan_slope(epilogue, ost, lengths=(4, 12))
+    ms_merge = do_bench_scan_slope(epilogue, (ost, lst, w), lengths=(4, 12))
     print(f"  merge/delta epilogue: {ms_merge:.2f} ms", flush=True)
 
     ms_fwd_total += ms_merge
@@ -315,6 +340,8 @@ def main() -> int:
         "area_frac": 1.0, "n_chunks": n_chunks,
         "ms": round(ms_fwd_total, 2), "tflops": round(tf_fwd, 2),
         "pct_nominal": round(tf_fwd / PEAK * 100, 1),
+        # rows are single-phase, so the whole-row taint is the right form
+        **({"suspect": 1} if suspect_fwd else {}),
     })
     tf = fwd_flops * 3.5 / (ms_fwdbwd_total * 1e-3) / 1e12
     print(f"config5 rank-shard fwd+bwd (100% coverage): "
@@ -325,6 +352,7 @@ def main() -> int:
         "area_frac": 1.0, "n_chunks": n_chunks,
         "ms": round(ms_fwdbwd_total, 2), "tflops": round(tf, 2),
         "pct_nominal": round(tf / PEAK * 100, 1),
+        **({"suspect": 1} if suspect_bwd else {}),
     })
     return 0
 

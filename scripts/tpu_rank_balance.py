@@ -37,8 +37,14 @@ except Exception:
 import jax.numpy as jnp
 import numpy as np
 
-from magiattention_tpu.benchmarking.bench import do_bench_scan_slope
-from magiattention_tpu.benchmarking.perf_report import append_row
+from magiattention_tpu.benchmarking.bench import (
+    do_bench_scan_slope,
+    make_fwd_kv_body,
+)
+from magiattention_tpu.benchmarking.perf_report import (
+    append_row,
+    credible_floor_ms,
+)
 from magiattention_tpu.common.enum import AttnMaskType
 from magiattention_tpu.common.ranges import AttnRanges
 from magiattention_tpu.kernels.ffa import (
@@ -64,16 +70,25 @@ def _time_plan(plan, w, wt, q, k, v, shard, sk_len, label):
     )
     arrays = plan_arrays(plan)
 
-    def fwd(qq):
-        return ffa_attn_with_plan(qq, k, v, arrays, params)[0].astype(
-            jnp.bfloat16
-        )
-
-    ms = do_bench_scan_slope(fwd, q, verbose=True)
+    # k/v ride the carry (jit arguments): a closed-over jax.Array lowers
+    # as an HLO constant, and the 262k kv here is ~1 GB — a payload the
+    # tunnel's remote-compile helper answers with "Broken pipe"
+    fwd = make_fwd_kv_body(
+        lambda qq, kk, vv: ffa_attn_with_plan(qq, kk, vv, arrays, params)[0],
+        jnp.bfloat16,
+    )
+    # credibility floor from the EXACT hardware work: every counted work
+    # tile runs full (bq, bk) matmuls on the MXU regardless of banding,
+    # so 4*W*bq*bk*D*hq is the true executed-flop count
+    floor = credible_floor_ms(4.0 * w * BQ * BK * D * HQ)
+    ms = do_bench_scan_slope(
+        fwd, (q, k, v), verbose=True, min_credible_ms=floor
+    )
     print(f"{label}: {ms:8.3f} ms (W={w})", flush=True)
     append_row("rank_balance", {
         "probe": label, "ms": round(ms, 4), "w": w,
         "shard": shard, "sk": sk_len, "block_q": BQ, "block_k": BK,
+        **({"suspect": 1} if ms < floor else {}),
     })
     return ms
 

@@ -26,6 +26,34 @@ def test_append_and_report(history_dir):
     assert "video/4096" in report
 
 
+def test_suspect_rows_never_set_a_baseline(history_dir):
+    """A row the harness marked unphysical (rate above the chip ceiling
+    even at the long-scan upper bound) stays in the CSV as raw data but
+    must not appear in — or anchor the delta of — the report."""
+    pr.append_row("k", {"mask": "full", "seqlen": 8192, "tflops": 80.0})
+    pr.append_row(
+        "k", {"mask": "full", "seqlen": 8192, "tflops": 250.5, "suspect": 1}
+    )
+    report = pr.history_report("k", ["mask", "seqlen"], "tflops")
+    assert "250.5" not in report
+    assert "tflops=80" in report
+    assert len(list(csv.DictReader(open(history_dir / "k.csv")))) == 2
+
+
+def test_phase_suspect_taints_only_that_phase(history_dir):
+    """suspect_fwd bars a row from fwd_* reports but its valid fwdbwd
+    measurement must still set the baseline (one bad slope pair must not
+    discard the row's other, physical metric)."""
+    pr.append_row("k", {
+        "mask": "full", "seqlen": 8192,
+        "fwd_tflops": 250.5, "fwdbwd_tflops": 81.5, "suspect_fwd": 1,
+    })
+    fwd = pr.history_report("k", ["mask", "seqlen"], "fwd_tflops")
+    fwdbwd = pr.history_report("k", ["mask", "seqlen"], "fwdbwd_tflops")
+    assert "250.5" not in fwd
+    assert "81.5" in fwdbwd
+
+
 def test_schema_evolution_rewrites_header(history_dir):
     pr.append_row("k", {"a": 1})
     pr.append_row("k", {"a": 2, "b": 3})  # new column
