@@ -9,9 +9,9 @@
 # decode -> calibrate -> overlap -> auto-tile grid -> 8k/32k grid ->
 # reproducibility re-passes of the 08:29-recorded probes), logging into
 # timestamped files so each window appends to the history rather than
-# overwriting the last one. Windows are ~10 min, so after a window closes
-# it keeps probing every 2 min (kernels change during the round; every
-# window is worth a re-measure). All compiles go through the persistent
+# overwriting the last one. Windows range ~4 min to 2h+, so after a window
+# closes it keeps probing every 45 s (kernels change during the round;
+# every window is worth a re-measure). All compiles go through the persistent
 # compilation cache (.jax_cache) so later windows -- and the driver's
 # round-end bench -- skip recompiles.
 #
