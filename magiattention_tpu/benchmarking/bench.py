@@ -161,9 +161,17 @@ def do_bench_scan_slope(
     if floor_hit:
         ok = False
     if verbose:
+        if floor_hit:
+            from .perf_report import MEASURED_CEILING_TFLOPS
+
+            # the floor is anchored at the measured chip ceiling, so the
+            # implied rate scales as floor/slope
+            implied_tf = MEASURED_CEILING_TFLOPS * min_credible_ms / slope
         guard = "" if ok else (
-            f" -> CREDIBILITY FLOOR ({min_credible_ms:.3f} ms): slope is "
-            f"above the chip ceiling — under-cancelled pair, fallback to "
+            f" -> CREDIBILITY FLOOR ({min_credible_ms:.3f} ms): slope "
+            f"implies a rate above the chip ceiling "
+            f"({implied_tf:.0f} TF/s > {MEASURED_CEILING_TFLOPS:.0f}) — "
+            f"under-cancelled pair, fallback to "
             f"len{long_} upper bound {t_long_best:.3f}"
             if floor_hit else
             f" -> NOISE GUARD: fallback to len{long_} upper bound "

@@ -69,6 +69,18 @@ def ffa_gqa_pack_dq() -> bool:
     return _get_int("MAGI_ATTENTION_FFA_GQA_PACK_DQ", 0) == 1
 
 
+def ffa_gqa_pack_dkv() -> bool:
+    """GQA-pack the dk/dv backward kernel (grid (hk, WT) instead of
+    (hk, WT, g)): the g query heads of a kv head are packed into the
+    sublane dimension of ONE MXU contraction per work item, so q/do are
+    fetched once per work item instead of per group member and the
+    s_t/dp_t/dk/dv matmuls run g x longer. ON by default — the unpacked
+    path loops the group innermost and starves the MXU (77 vs 138 TF/s on
+    r5 silicon); VMEM-guarded, falls back automatically when the packed
+    tiles would not fit or shapes do not divide."""
+    return _get_int("MAGI_ATTENTION_FFA_GQA_PACK_DKV", 1) == 1
+
+
 def ffa_gqa_pack() -> bool:
     """Pack the whole GQA query group of one kv head into each fwd grid
     step (grid (hk, W) instead of (hq, W)): k/v HBM traffic drops by the

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import shard_map
 
 from ..comm.primitives import cast_rows, reduce_rows
 from ..env import comm as env_comm
@@ -40,7 +40,7 @@ from ..env import kernel as env_kernel
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
-    _ffa_bwd_dkv_pallas,
+    ffa_bwd_dkv_pallas_dispatch,
     ffa_bwd_dq_pallas_dispatch,
     ffa_fwd_pallas_dispatch,
     _should_interpret,
@@ -136,7 +136,7 @@ def _multi_ffa_bwd(params_list, res, cts):
                 prm, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
             )
         with profile_scope("ffa_bwd_dkv"):
-            dk_t, dv_t = _ffa_bwd_dkv_pallas(
+            dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
                 prm, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
             )
         # dk/dv already per kv head (dkv kernel sums the GQA group); the
@@ -286,16 +286,19 @@ def _ragged_arrays(s) -> tuple[jax.Array, ...]:
     )
 
 
-def _stack_plans(args: list[AttnArg], sq: int, sk: int, bq: int, bk: int):
+def _stack_plans(args: list[AttnArg], sq: int, sk: int, bq: int, bk: int,
+                 policy_dq: tuple[int, int] | None = None,
+                 policy_dkv: tuple[int, int] | None = None):
     """Per-rank FFA plans -> rank-stacked arrays padded to a common size.
 
     Returns ``(stacked_arrays, dims)`` where dims feeds
     ``DistAttnRuntime._ffa_params``. When the env bwd-tile overrides
-    (MAGI_ATTENTION_FFA_BLOCK_*_D{Q,KV}) are active and compatible with
-    this plan group's padded geometry, the stack carries 12 arrays (fwd6 +
-    dq3 + dkv3) and dims includes the FFAParams override fields — so the
-    distributed runtimes honor the same tuning flags as single-device
-    ``ffa_attn``.
+    (MAGI_ATTENTION_FFA_BLOCK_*_D{Q,KV}) — or the auto-tile policy's
+    per-pass picks (``policy_dq``/``policy_dkv``; env wins) — are active
+    and compatible with this plan group's padded geometry, the stack
+    carries 12 arrays (fwd6 + dq3 + dkv3) and dims includes the FFAParams
+    override fields — so the distributed runtimes honor the same tuning
+    flags as single-device ``ffa_attn``.
     """
     from ..kernels.ffa import assemble_bwd_overrides
 
@@ -327,7 +330,8 @@ def _stack_plans(args: list[AttnArg], sq: int, sk: int, bq: int, bk: int):
         return triple, wt2
 
     stacked, overrides = assemble_bwd_overrides(
-        stacked, bq, bk, nqt, nkt, build_triple
+        stacked, bq, bk, nqt, nkt, build_triple,
+        policy_dq=policy_dq, policy_dkv=policy_dkv,
     )
     return stacked, (nqt, nkt, w, wt, overrides)
 
@@ -344,6 +348,9 @@ class DeferredTilePolicy:
     def _init_tile_policy(self, block_q, block_k) -> None:
         self._plan_sig = None
         self._auto_tile_pending = False
+        # per-pass picks from the auto-tile policy, consumed by the
+        # subclasses' _build_plans via _stack_plans (env overrides win)
+        self._policy_bwd: tuple = (None, None)
         if (
             block_q is None and block_k is None
             and not env_kernel.ffa_blocks_pinned()
@@ -361,10 +368,13 @@ class DeferredTilePolicy:
         sig = (d, dv, itemsize)
         if self._plan_sig == sig:
             return
-        from ..kernels.tile_policy import choose_blocks_multi
+        from ..kernels.tile_policy import choose_blocks_per_pass_multi
 
         geoms, sq, sk = self._tile_geoms()
-        blk_q, blk_k = choose_blocks_multi(geoms, sq, sk, d, dv, itemsize)
+        (blk_q, blk_k), pol_dq, pol_dkv = choose_blocks_per_pass_multi(
+            geoms, sq, sk, d, dv, itemsize
+        )
+        self._policy_bwd = (pol_dq, pol_dkv)
         self._build_plans(blk_q, blk_k)
         self._plan_sig = sig
 
@@ -481,16 +491,21 @@ class DistAttnRuntime(DeferredTilePolicy):
         total_recv = sum(km.recv_len_per_stage)
         bq, bk = default_blocks(shard, kv_shard + total_recv, blk_q, blk_k)
         self._bq, self._bk = bq, bk
+        pol_dq, pol_dkv = getattr(self, "_policy_bwd", (None, None))
 
         # merged (no-overlap) plan
         self._merged_arrays, self._merged_dims = _stack_plans(
-            km.merged_args, shard, kv_shard + total_recv, bq, bk
+            km.merged_args, shard, kv_shard + total_recv, bq, bk,
+            policy_dq=pol_dq, policy_dkv=pol_dkv,
         )
 
         if self.use_overlap:
+            # stage geometries clamp bk; policy picks that don't divide a
+            # stage's padded grid silently inherit (resolve gate)
             self._host_arrays, self._host_dims = _stack_plans(
                 km.host_args, shard, kv_shard,
                 bq, min(bk, _ceil_to(kv_shard, 128)),
+                policy_dq=pol_dq, policy_dkv=pol_dkv,
             )
             self._stage_arrays = []
             self._stage_dims = []
@@ -499,6 +514,7 @@ class DistAttnRuntime(DeferredTilePolicy):
                 sa, sdims = _stack_plans(
                     km.remote_args_per_stage[st], shard, rl,
                     bq, min(bk, _ceil_to(rl, 128)),
+                    policy_dq=pol_dq, policy_dkv=pol_dkv,
                 )
                 self._stage_arrays.append(sa)
                 self._stage_dims.append(sdims)

@@ -28,7 +28,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm.primitives import cast_rows, reduce_rows
@@ -36,7 +36,7 @@ from ..env import general as env_general
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
-    _ffa_bwd_dkv_pallas,
+    ffa_bwd_dkv_pallas_dispatch,
     ffa_bwd_dq_pallas_dispatch,
     _should_interpret,
     default_blocks,
@@ -151,7 +151,7 @@ def _dyn_bwd(static, axis, res, cts):
     dq_t = ffa_bwd_dq_pallas_dispatch(
         params, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
-    dk_t, dv_t = _ffa_bwd_dkv_pallas(
+    dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
         params, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     # dk/dv already per kv head (dkv kernel sums the GQA group)
@@ -234,8 +234,10 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
             p = self.plan
             bq, bk = default_blocks(p.q_buf_len, p.k_buf_len, blk_q, blk_k)
             self._bq, self._bk = bq, bk
+            pol_dq, pol_dkv = getattr(self, "_policy_bwd", (None, None))
             self._arrays, self._dims = _stack_plans(
-                p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk
+                p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk,
+                policy_dq=pol_dq, policy_dkv=pol_dkv,
             )
 
     def _tile_geoms(self):

@@ -227,7 +227,7 @@ def _ffa_sink_core_fwd(q, k, v, sink, arrays, params, sink_layout):
 def _ffa_sink_core_bwd(params, sink_layout, res, cts):
     from ..kernels.ffa import (
         _bwd_plan_slices,
-        _ffa_bwd_dkv_pallas,
+        ffa_bwd_dkv_pallas_dispatch,
         ffa_bwd_dq_pallas_dispatch,
     )
     from .dist_attn import _head_major
@@ -251,7 +251,7 @@ def _ffa_sink_core_bwd(params, sink_layout, res, cts):
     dq_t = ffa_bwd_dq_pallas_dispatch(
         params, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
-    dk_t, dv_t = _ffa_bwd_dkv_pallas(
+    dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
         params, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     # dk/dv already per kv head (dkv kernel sums the GQA group)

@@ -72,6 +72,12 @@ from .mask_utils import types_to_bands
 NEG_INF = float("-inf")
 NUM_LANES = 128
 NUM_SUBLANES = 8
+# jax < 0.5 exposes the TPU compiler params as TPUCompilerParams; newer
+# versions renamed it. Resolve once so the kernel layer imports (and the
+# CPU/interpret parity suite runs) on either.
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
 # exp2-domain softmax (softcap-free path): folding log2(e) into the q
 # pre-scale turns every exp(x) into a bare exp2, deleting the per-element
 # multiply Mosaic otherwise emits inside exp (flash_attention's idiom)
@@ -152,19 +158,22 @@ def _item_mask(
     The scalar is_full flag is OR-ed in (splash's should_not_mask idiom), so
     interior tiles need no separate code path.
 
-    ``repeat`` > 1 (q rows only) emits a vertically-repeated
-    ``(repeat*bq, bk)`` mask — the same q tile stacked for ``repeat``
-    packed heads — via iota-mod rather than an i1 tile (which Mosaic
-    cannot relayout).
+    ``repeat`` > 1 emits the same q tile stacked for ``repeat`` packed
+    heads — ``(repeat*bq, bk)`` (q rows) or ``(bk, repeat*bq)``
+    (transposed; packed heads along lanes) — via iota-mod rather than an
+    i1 tile (which Mosaic cannot relayout).
     """
     qs, qe = meta_ref[w, QS], meta_ref[w, QE]
     ks, ke = meta_ref[w, KS], meta_ref[w, KE]
     lo, hi = meta_ref[w, DLO], meta_ref[w, DHI]
     full = meta_ref[w, IS_FULL] == 1
     if transposed:
-        assert repeat == 1
-        rows = q_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
-        cols = k_base + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+        shape = (bk, repeat * bq)
+        rows = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        if repeat > 1:
+            rows = jax.lax.rem(rows, jnp.int32(bq))
+        rows = q_base + rows
+        cols = k_base + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
     else:
         shape = (repeat * bq, bk)
         rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
@@ -370,7 +379,7 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
             lse_shape,
         ] + ([lse_shape] if emit_ml else []),
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -557,7 +566,7 @@ def _ffa_fwd_pallas_gqa(
             jax.ShapeDtypeStruct((hk, g, sqp, NUM_LANES), jnp.float32),
         ],
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -741,7 +750,7 @@ def _ffa_bwd_dq_pallas(
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((hq, sqp, d), jnp.float32)],
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
     )(work_qt, work_kt, meta, q_t, k_t, v_t, do_t,
@@ -914,7 +923,7 @@ def _ffa_bwd_dq_pallas_gqa(
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((hk, g, sqp, d), jnp.float32)],
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
     )(work_qt, work_kt, meta, q_g, k_t, v_t, do_g, lse_p, delta_p)
@@ -1146,7 +1155,7 @@ def _ffa_bwd_dkv_pallas(
             jax.ShapeDtypeStruct((hk, skp, dv), jnp.float32),
         ],
         interpret=params.interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
     )(work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
@@ -1155,6 +1164,230 @@ def _ffa_bwd_dkv_pallas(
     if use_exp2:
         dk_t = dk_t * LN2  # divide the folded log2e back out
     return dk_t, dv_t
+
+
+def _bwd_dkv_kernel_gqa(
+    work_qt_ref,
+    work_kt_ref,
+    meta_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    softcap: float,
+    bq: int,
+    bk: int,
+    g: int,
+):
+    """GQA-packed dk/dv: grid (hk, WT) — the whole query group of one kv
+    head per grid step (vs :func:`_bwd_dkv_kernel`'s (hk, WT, g) with the
+    group innermost). q/do arrive as (g, bq, ·) blocks reshaped to packed
+    (g*bq, ·) rows, so s_t/dp_t are ONE (bk, g*bq) MXU contraction and the
+    dk/dv accumulations contract over all g heads at once — summing the
+    packed columns IS the group sum, since each packed column belongs to
+    exactly one (head, row) pair. q/do are fetched once per work item
+    instead of per group member and the matmuls run ``g``x longer,
+    feeding the MXU full tiles (FlashAttention-2's bwd work-partitioning
+    lesson). lse/delta arrive TILE-PACKED (:func:`_tile_pack_rows`) and
+    broadcast over the bk rows.
+    """
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    is_full = meta_ref[w, IS_FULL]
+    use_exp2 = softcap == 0.0
+    exp_fn = jnp.exp2 if use_exp2 else jnp.exp
+
+    @pl.when(is_first == 1)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    d = q_ref.shape[-1]
+    dv = v_ref.shape[-1]
+    q = q_ref[0].reshape(g * bq, d)  # pre-scaled on host
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].reshape(g * bq, dv)
+    # s_t: (bk, g*bq) — k rows, packed (head, q-row) cols
+    s_t = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if softcap > 0.0:
+        sc_t = softcap * jnp.tanh(s_t / softcap)
+        dcap_t = 1.0 - (sc_t / softcap) ** 2
+    else:
+        sc_t = s_t
+        dcap_t = None
+
+    lse = lse_ref[...]  # (1, g*bq), tile-packed cols; broadcasts over bk rows
+    delta = delta_ref[...]
+    dp_t = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    def accum(sm_t, masked: bool):
+        if masked:
+            neg = lse <= EMPTY_THRESH
+            lse_safe = jnp.where(neg, 0.0, lse)
+            if use_exp2:
+                lse_safe = lse_safe * LOG2E
+            p_t = exp_fn(sm_t - lse_safe)
+            p_t = jnp.where(neg, 0.0, p_t)
+        else:
+            p_t = exp_fn(sm_t - (lse * LOG2E if use_exp2 else lse))
+        # contraction over the g*bq packed cols == the per-group sum the
+        # unpacked kernel does across its g inner grid steps
+        dv_scr[:] += jax.lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - delta)
+        if dcap_t is not None:
+            ds_t = ds_t * dcap_t
+        dk_scr[:] += jax.lax.dot_general(
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(is_full == 1)
+    def _():
+        accum(sc_t, masked=False)
+
+    @pl.when(is_full == 0)
+    def _():
+        q_base = work_qt_ref[w] * bq
+        k_base = work_kt_ref[w] * bk
+        accum(
+            jnp.where(
+                _item_mask(meta_ref, w, q_base, k_base, bq, bk,
+                           transposed=True, repeat=g),
+                sc_t, MASK_VALUE,
+            ),
+            masked=True,
+        )
+
+    @pl.when(is_last == 1)
+    def _():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+def _ffa_bwd_dkv_pallas_gqa(
+    params: FFAParams, work_qt_t, work_kt_t, meta_t,
+    q_t, k_t, v_t, do_t, lse_t, delta_t,
+):
+    """GQA-packed dk/dv pallas call (see :func:`_bwd_dkv_kernel_gqa`)."""
+    bq, bk = params.dkv_blocks()
+    hq, sqp, d = q_t.shape
+    hk, skp, dv = v_t.shape
+    g = params.group
+    WT = (
+        params.num_work_dkv
+        if params.num_work_dkv is not None
+        else params.num_work_t
+    )
+
+    use_exp2 = params.softcap == 0.0
+    q_scale = params.softmax_scale * (LOG2E if use_exp2 else 1.0)
+    q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
+    q_g = q_t.reshape(hk, g, sqp, d)
+    do_g = do_t.reshape(hk, g, sqp, dv)
+    lse_p = _tile_pack_rows(_clamp_lse(lse_t), hk, g, bq)
+    delta_p = _tile_pack_rows(delta_t, hk, g, bq)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(hk, WT),
+        in_specs=[
+            pl.BlockSpec((1, g, bq, d),
+                         lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, g, bq, dv),
+                         lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, None, 1, g * bq),
+                         lambda h, w, qt, kt, mt: (h, qt[w], 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, None, 1, g * bq),
+                         lambda h, w, qt, kt, mt: (h, qt[w], 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, dv), jnp.float32),
+        ],
+    )
+    kernel = partial(
+        _bwd_dkv_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g,
+    )
+    dk_t, dv_t = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hk, skp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hk, skp, dv), jnp.float32),
+        ],
+        interpret=params.interpret,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(work_qt_t, work_kt_t, meta_t, q_g, k_t, v_t, do_g, lse_p, delta_p)
+    if use_exp2:
+        dk_t = dk_t * LN2  # divide the folded log2e back out
+    return dk_t, dv_t
+
+
+def _use_gqa_pack_dkv(params: FFAParams, sqp: int, d: int, dv: int) -> bool:
+    """Trace-time dispatch to the packed dkv kernel. ON by default when
+    there is real grouping (env flag ``ffa_gqa_pack_dkv``) and shapes
+    divide (the dkv q tile must tile the padded seqlen for the host-side
+    lse/delta tile-pack). VMEM guard: the packed (bk, g*bq) fp32
+    s_t + dp_t tiles plus the (bk, d+dv) fp32 dk/dv scratch must stay
+    well under the ~16 MB budget."""
+    bq, bk = params.dkv_blocks()
+    return (
+        env_kernel.ffa_gqa_pack_dkv()
+        and params.group > 1
+        and sqp % bq == 0
+        and (2 * params.group * bq * bk + bk * (d + dv)) * 4
+        <= 8 * 1024 * 1024
+    )
+
+
+def ffa_bwd_dkv_pallas_dispatch(
+    params: FFAParams, work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
+    lse_t, delta_t,
+):
+    """dk/dv backward with the GQA-packing dispatch applied — the ONE
+    entry every backward path (custom-vjp core, CP multi-stage, sink,
+    dynamic) uses so the packed dkv kernel is reachable from all of them
+    (mirrors :func:`ffa_bwd_dq_pallas_dispatch`)."""
+    fn = (
+        _ffa_bwd_dkv_pallas_gqa
+        if _use_gqa_pack_dkv(params, q_t.shape[1], q_t.shape[2],
+                             v_t.shape[2])
+        else _ffa_bwd_dkv_pallas
+    )
+    return fn(params, work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
+              lse_t, delta_t)
 
 
 # ---------------------------------------------------------------------------
@@ -1219,7 +1452,7 @@ def _ffa_core_bwd(params: FFAParams, res, cts):
     dq_t = ffa_bwd_dq_pallas_dispatch(
         params, *dq_arrays, q_t, kc, vc, do_t, lse_t, delta_t
     )
-    dk_t, dv_t = _ffa_bwd_dkv_pallas(
+    dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
         params, *dkv_arrays, q_t, kc, vc, do_t, lse_t, delta_t,
     )
     # dk/dv already come back per kv head: the dkv kernel accumulates the
@@ -1281,19 +1514,26 @@ def ffa_attn_with_plan(
 
 
 def resolve_bwd_overrides(
-    bq: int, bk: int, sqp: int, skp: int
+    bq: int, bk: int, sqp: int, skp: int,
+    policy_dq: tuple[int, int] | None = None,
+    policy_dkv: tuple[int, int] | None = None,
 ) -> tuple[tuple[int, int] | None, tuple[int, int] | None]:
-    """Env bwd-tile overrides resolved against a padded geometry.
+    """Bwd-tile overrides resolved against a padded geometry.
 
     Returns ``(dq_blocks, dkv_blocks)``; an entry is None when unset or
     incompatible (the bwd kernels index the same padded q/k/v and lse
     buffers as fwd, so the override must divide the fwd-padded geometry and
     satisfy TPU alignment — incompatible values silently inherit fwd's).
+    ``policy_dq``/``policy_dkv`` are the auto-tile policy's per-pass picks
+    (:func:`tile_policy.choose_blocks_per_pass`); explicit env settings
+    always take precedence over them, component-wise.
     """
 
-    def gate(env_bq: int, env_bk: int) -> tuple[int, int] | None:
-        obq = env_bq or bq
-        obk = env_bk or bk
+    def gate(env_bq: int, env_bk: int,
+             policy: tuple[int, int] | None) -> tuple[int, int] | None:
+        pol_bq, pol_bk = policy or (0, 0)
+        obq = env_bq or pol_bq or bq
+        obk = env_bk or pol_bk or bk
         obq, obk = min(obq, sqp), min(obk, skp)
         if (
             (obq, obk) == (bq, bk)
@@ -1304,14 +1544,18 @@ def resolve_bwd_overrides(
         return obq, obk
 
     return (
-        gate(env_kernel.ffa_block_q_dq(), env_kernel.ffa_block_k_dq()),
-        gate(env_kernel.ffa_block_q_dkv(), env_kernel.ffa_block_k_dkv()),
+        gate(env_kernel.ffa_block_q_dq(), env_kernel.ffa_block_k_dq(),
+             policy_dq),
+        gate(env_kernel.ffa_block_q_dkv(), env_kernel.ffa_block_k_dkv(),
+             policy_dkv),
     )
 
 
 def assemble_bwd_overrides(
     arrays: tuple, bq: int, bk: int, num_q_tiles: int, num_k_tiles: int,
     build_triple,
+    policy_dq: tuple[int, int] | None = None,
+    policy_dkv: tuple[int, int] | None = None,
 ) -> tuple[tuple, dict]:
     """Shared override assembly for single-device and stacked (CP) plans —
     ONE place defines the 12-array layout and FFAParams override fields.
@@ -1326,7 +1570,8 @@ def assemble_bwd_overrides(
     when an override is active.
     """
     dq_blocks, dkv_blocks = resolve_bwd_overrides(
-        bq, bk, num_q_tiles * bq, num_k_tiles * bk
+        bq, bk, num_q_tiles * bq, num_k_tiles * bk,
+        policy_dq=policy_dq, policy_dkv=policy_dkv,
     )
     overrides: dict = {}
     if not (dq_blocks or dkv_blocks):
@@ -1351,6 +1596,8 @@ def assemble_bwd_overrides(
 def apply_bwd_overrides(
     arrays: tuple, qr, kr, d_lo, d_hi, sq: int, sk: int, bq: int, bk: int,
     num_q_tiles: int, num_k_tiles: int,
+    policy_dq: tuple[int, int] | None = None,
+    policy_dkv: tuple[int, int] | None = None,
 ) -> tuple[tuple, dict]:
     """Single-plan wrapper of :func:`assemble_bwd_overrides`."""
 
@@ -1361,7 +1608,8 @@ def apply_bwd_overrides(
         return plan_arrays(p)[3:6], p.num_work_t
 
     return assemble_bwd_overrides(
-        arrays, bq, bk, num_q_tiles, num_k_tiles, build_triple
+        arrays, bq, bk, num_q_tiles, num_k_tiles, build_triple,
+        policy_dq=policy_dq, policy_dkv=policy_dkv,
     )
 
 
@@ -1417,15 +1665,20 @@ def ffa_attn(
     sk, hk, dv = v.shape
     if softmax_scale is None:
         softmax_scale = float(d) ** -0.5
+    policy_dq = policy_dkv = None
     if block_q is None and block_k is None and not env_kernel.ffa_blocks_pinned():
-        from .tile_policy import auto_tile_enabled, choose_blocks
+        from .tile_policy import auto_tile_enabled, choose_blocks_per_pass
 
         if auto_tile_enabled():
-            # plan-geometry-driven tile choice (ref tile tables analogue);
-            # explicit env/arg settings always take precedence
-            block_q, block_k = choose_blocks(
-                qr, kr, d_lo, d_hi, sq, sk, d, dv,
-                itemsize=q.dtype.itemsize,
+            # plan-geometry-driven, per-PASS tile choice (ref tile tables
+            # analogue): fwd/dq score the q-major plan, dkv the k-major one,
+            # and thin bands get their own block_k candidates; explicit
+            # env/arg settings always take precedence
+            (block_q, block_k), policy_dq, policy_dkv = (
+                choose_blocks_per_pass(
+                    qr, kr, d_lo, d_hi, sq, sk, d, dv,
+                    itemsize=q.dtype.itemsize,
+                )
             )
     bq, bk = default_blocks(sq, sk, block_q, block_k)
 
@@ -1434,6 +1687,7 @@ def ffa_attn(
     arrays, overrides = apply_bwd_overrides(
         arrays, qr, kr, d_lo, d_hi, sq, sk, bq, bk,
         plan.num_q_tiles, plan.num_k_tiles,
+        policy_dq=policy_dq, policy_dkv=policy_dkv,
     )
 
     params = FFAParams(

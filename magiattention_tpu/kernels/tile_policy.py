@@ -36,8 +36,17 @@ NUM_LANES = 128
 # (benchmarks/history/true_rate.csv A/Bs) — see docs/performance.md.
 OVERHEAD_ELEMS = 8 * 1024
 # candidate tilings: bq multiples of 8 (fp32) / MXU-friendly, bk multiples
-# of 128 (lane tiling); spans the sweep grid the silicon harnesses measure
+# of 128 (lane tiling); spans the sweep grid the silicon harnesses measure.
+# The small-bk rows exist for thin bands (sliding-window, varlen tails):
+# a 128-wide band inside a 512-wide k tile runs 4x the padded MXU work,
+# and the exact per-slice work counting below is what detects that.
 CANDIDATES: tuple[tuple[int, int], ...] = (
+    (128, 128),
+    (256, 128),
+    (512, 128),
+    (128, 256),
+    (256, 256),
+    (512, 256),
     (128, 512),
     (256, 512),
     (256, 1024),
@@ -100,6 +109,50 @@ def count_ffa_work(
     return total + int(num_q_tiles - covered.sum())
 
 
+def count_ffa_work_t(
+    qr: np.ndarray,
+    kr: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+    sq: int,
+    sk: int,
+    bq: int,
+    bk: int,
+) -> int:
+    """Exact K-MAJOR work-item count (the dkv pass's grid length) for this
+    tiling, mirroring :func:`count_ffa_work`'s closed form with the roles
+    of q and k swapped: one item per (slice, k_tile, q_tile) whose band
+    intersects the clipped tile rect (per k tile the attended row span is
+    one interval, so the intersecting q tiles form a contiguous run), plus
+    the builder's one dummy item per never-covered k tile (those still
+    need a grid step to write their zero dk/dv). Parity with the builder's
+    ``num_work_t`` is pinned by test.
+    """
+    total = 0
+    num_q_tiles = max(1, -(-sq // bq))
+    num_k_tiles = max(1, -(-sk // bk))
+    covered = np.zeros(num_k_tiles, dtype=bool)
+    for s in range(len(qr)):
+        qs, qe = int(qr[s, 0]), int(qr[s, 1])
+        ks, ke = int(kr[s, 0]), int(kr[s, 1])
+        lo, hi = int(d_lo[s]), int(d_hi[s])
+        if qs >= qe or ks >= ke or lo > hi:
+            continue
+        t = np.arange(ks // bk, (ke - 1) // bk + 1, dtype=np.int64)
+        j0 = np.maximum(ks, t * bk)  # clipped col span per k tile
+        j1 = np.minimum(ke, (t + 1) * bk)
+        # attended row window of the clipped cols (lo <= j - i <= hi  ⟺
+        # j - hi <= i <= j - lo), clipped to [qs, qe)
+        i0 = np.maximum(qs, j0 - hi)
+        i1 = np.minimum(qe - 1, (j1 - 1) - lo)
+        nonempty = i0 <= i1
+        qt0 = np.clip(i0 // bq, 0, num_q_tiles - 1)
+        qt1 = np.clip(i1 // bq, 0, num_q_tiles - 1)
+        total += int(np.sum((qt1 - qt0 + 1)[nonempty]))
+        covered[t[nonempty]] = True
+    return total + int(num_k_tiles - covered.sum())
+
+
 def _vmem_bytes(bq: int, bk: int, d: int, dv: int, itemsize: int) -> int:
     """Per-step fwd-kernel VMEM residency — ONE estimator for the whole
     package (utils/mem_budget.ffa_vmem_budget)."""
@@ -159,6 +212,128 @@ def choose_blocks(
 ) -> tuple[int, int]:
     """Single-slice-set entry of :func:`choose_blocks_multi`."""
     return choose_blocks_multi(
+        [(qr, kr, d_lo, d_hi)], sq, sk, d, dv, itemsize
+    )
+
+
+def _bwd_vmem_bytes(
+    kind: str, bq: int, bk: int, d: int, dv: int, itemsize: int
+) -> int:
+    """Per-step VMEM residency of the bwd kernels: the fwd estimator's
+    resident blocks plus the pass's fp32 accumulator scratch and score
+    tile ((bq,bk) for dq, transposed for dkv — same size)."""
+    scratch = bq * d if kind == "dq" else bk * (d + dv)
+    return _vmem_bytes(bq, bk, d, dv, itemsize) + 4 * (scratch + bq * bk)
+
+
+def _band_candidates(
+    rank_geoms: list, sq: int, sk: int
+) -> tuple[tuple[int, int], ...]:
+    """CANDIDATES extended with a block_k derived from the narrowest
+    band in the slice set: thin bands (sliding window, varlen tails)
+    waste padded MXU columns in any k tile wider than the band, so the
+    band width itself (rounded up to the lane quantum) is always worth
+    scoring alongside the fixed grid."""
+    widths = []
+    for qr, kr, lo, hi in rank_geoms:
+        for s in range(len(qr)):
+            if qr[s, 0] >= qr[s, 1] or kr[s, 0] >= kr[s, 1]:
+                continue
+            band = int(hi[s]) - int(lo[s]) + 1
+            rect = int(kr[s, 1]) - int(kr[s, 0])
+            widths.append(min(max(band, 0), rect))
+    if not widths:
+        return CANDIDATES
+    bk_band = min(max(_round_up(min(widths), NUM_LANES), NUM_LANES), 1024)
+    extra = tuple((bq, bk_band) for bq in (128, 256, 512))
+    return CANDIDATES + extra
+
+
+def choose_blocks_per_pass_multi(
+    rank_geoms: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+    sq: int,
+    sk: int,
+    d: int = 128,
+    dv: int = 128,
+    itemsize: int = 2,
+) -> tuple[
+    tuple[int, int], tuple[int, int] | None, tuple[int, int] | None
+]:
+    """Per-PASS tile choice: ``(fwd_blocks, dq_blocks, dkv_blocks)``.
+
+    The three passes score differently over the same slice set: fwd and
+    dq run the q-major plan, dkv the k-major plan (its work count — and
+    so its padded-area profile — differs whenever bands are thin or
+    ragged), and each pass has its own VMEM residency (the dkv kernel
+    holds (bk, d+dv) fp32 scratch). A bwd entry is None when the fwd
+    choice is already optimal for that pass (inherit — the plan tuple
+    stays at 6 arrays). Bwd candidates are constrained to divide the
+    fwd-padded geometry, the same gate :func:`ffa.resolve_bwd_overrides`
+    applies to env overrides.
+    """
+    cands = _band_candidates(rank_geoms, sq, sk)
+
+    def score_pass(kind: str, allowed=None):
+        seen: set[tuple[int, int]] = set()
+        best = None
+        best_score = None
+        counter = count_ffa_work_t if kind == "dkv" else count_ffa_work
+        for bq, bk in cands:
+            bq = min(bq, _round_up(sq, 16))
+            bk = min(bk, _round_up(sk, NUM_LANES))
+            if (bq, bk) in seen:
+                continue
+            seen.add((bq, bk))
+            if allowed is not None and not allowed(bq, bk):
+                continue
+            if kind == "fwd":
+                vmem = _vmem_bytes(bq, bk, d, dv, itemsize)
+            else:
+                vmem = _bwd_vmem_bytes(kind, bq, bk, d, dv, itemsize)
+            if vmem > VMEM_BUDGET:
+                continue
+            w = max(
+                counter(qr, kr, lo, hi, sq, sk, bq, bk)
+                for qr, kr, lo, hi in rank_geoms
+            )
+            score = w * (bq * bk + OVERHEAD_ELEMS)
+            if best_score is None or score < best_score:
+                best, best_score = (bq, bk), score
+        return best
+
+    fwd = score_pass("fwd") or (
+        min(256, _round_up(sq, 16)), min(512, _round_up(sk, NUM_LANES))
+    )
+    sqp = _round_up(sq, fwd[0])
+    skp = _round_up(sk, fwd[1])
+
+    def divides(bq: int, bk: int) -> bool:
+        return sqp % bq == 0 and skp % bk == 0
+
+    dq = score_pass("dq", allowed=divides)
+    dkv = score_pass("dkv", allowed=divides)
+    if dq == fwd:
+        dq = None
+    if dkv == fwd:
+        dkv = None
+    return fwd, dq, dkv
+
+
+def choose_blocks_per_pass(
+    qr: np.ndarray,
+    kr: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+    sq: int,
+    sk: int,
+    d: int,
+    dv: int,
+    itemsize: int = 2,
+) -> tuple[
+    tuple[int, int], tuple[int, int] | None, tuple[int, int] | None
+]:
+    """Single-slice-set entry of :func:`choose_blocks_per_pass_multi`."""
+    return choose_blocks_per_pass_multi(
         [(qr, kr, d_lo, d_hi)], sq, sk, d, dv, itemsize
     )
 

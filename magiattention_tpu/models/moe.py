@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..api import dispatch, get_mesh, get_position_ids
+from ..utils.compat import shard_map
 from ..dist_attn_runtime_mgr import DistAttnRuntimeKey
 from .llama import LlamaConfig, _rms_norm, attn_block, masked_ce
 
@@ -250,7 +251,7 @@ def moe_ffn(h, lyr, cfg: MoEConfig, mesh=None, ep_axis=None):
         return _moe_ffn_local(h, *args, cfg, ep_axis, ep)
     ep = mesh.shape[ep_axis]
     _check_experts_divisible(cfg.n_experts, ep, ep_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_moe_ffn_local, cfg=cfg, ep_axis=ep_axis, ep=ep),
         mesh=mesh,
         in_specs=(

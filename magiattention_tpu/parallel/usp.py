@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import jax
 import numpy as np
-from jax import shard_map
+from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..functional.dist_attn import _multi_ffa

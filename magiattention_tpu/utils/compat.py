@@ -1,0 +1,26 @@
+"""Cross-version JAX compatibility shims.
+
+The package targets current JAX, where ``shard_map`` lives in the
+top-level namespace and takes ``check_vma``; older releases ship it under
+``jax.experimental.shard_map`` with the ``check_rep`` spelling. Importing
+from here instead of ``jax`` keeps the whole functional/parallel stack
+importable on both (the same pattern as ffa.py's ``_CompilerParams``
+alias for the TPUCompilerParams rename).
+"""
+
+from __future__ import annotations
+
+try:  # JAX >= 0.6: promoted to the top-level namespace
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # older JAX: experimental location, check_rep kwarg
+    import functools
+
+    from jax.experimental.shard_map import (  # type: ignore[import]
+        shard_map as _shard_map,
+    )
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, *args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(f, *args, **kwargs)

@@ -56,13 +56,14 @@ PEAK = 197.0
 LENGTHS = (2, 4) if SMOKE else (24, 96)
 
 
-def record(probe, ms, flops, *, lengths):
+def record(probe, ms, flops, *, lengths, extra=None):
     """Append one slope-timed row. ``lengths`` is REQUIRED and must be the
     scan trip counts the measurement actually used (ffa probes use
     ATT_LENGTHS, mm probes LENGTHS) — fit_tile_overhead.py keys its shape
     guard on len_short, so a mismatched stamp silently disqualifies the
     row; requiring it keeps future call sites from inheriting a wrong
-    default."""
+    default. ``extra`` merges additional columns (e.g. the splash
+    ``BlockSizes`` config a row was measured with)."""
     tf = flops / (ms * 1e-3) / 1e12
     print(f"{probe}: {ms:.3f} ms {tf:.1f} TF/s ({tf/PEAK*100:.1f}% of nominal)",
           flush=True)
@@ -72,8 +73,32 @@ def record(probe, ms, flops, *, lengths):
         "probe": probe, "ms": round(ms, 4), "tflops": round(tf, 2),
         "pct_of_nominal": round(tf / PEAK * 100, 1),
         "len_short": lengths[0], "len_long": lengths[1],
+        **(extra or {}),
     })
     return tf
+
+
+def _splash_candidates(s):
+    """BlockSizes sweep for the splash baseline. FFA runs its tuned
+    512/512 tiling, so timing splash at library defaults (128 everywhere)
+    under-states the bar (r5 verdict weak #2); each candidate sets fwd AND
+    bwd blocks so the fwdbwd probe of the winner is covered too. Returns
+    [(label, BlockSizes)] — 'default' first so a window that dies mid-sweep
+    still produced the historical baseline config."""
+    from jax.experimental.pallas.ops.tpu import splash_attention as _sp
+
+    BS = _sp.splash_attention_kernel.BlockSizes
+    cands = [("default", BS.get_default())]
+    for bq, bkv in ((256, 512), (512, 512), (512, 1024)):
+        if bq > s or bkv > s:
+            continue  # smoke shapes
+        cands.append((
+            f"bq{bq}_bkv{bkv}",
+            BS(block_q=bq, block_kv=bkv, block_kv_compute=bkv,
+               block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
+               block_q_dq=bq, block_kv_dq=bkv),
+        ))
+    return cands[:2] if SMOKE else cands
 
 
 def main():
@@ -175,11 +200,6 @@ def main():
         gqa_mask = _sp.MultiHeadMask(
             [_sp.CausalMask((S, S)) for _ in range(GRP)]
         )
-        gqa_kernel = jax.vmap(
-            _sp.splash_attention_kernel.make_splash_mqa_single_device(
-                gqa_mask, interpret=SMOKE
-            )
-        )
         qg = jnp.asarray(
             rng.standard_normal((HK, GRP, S, D)), jnp.bfloat16
         )
@@ -189,24 +209,52 @@ def main():
             rng.standard_normal((HK, GRP, S, D)), jnp.bfloat16
         )
 
-        def splash_gqa_fwd(q):
-            return gqa_kernel(q, kg, vg).astype(jnp.bfloat16)
+        # BlockSizes sweep — the ratio of record must bar FFA against the
+        # best splash config, not the library default
+        best_label, best_kernel, best_ms = None, None, float("inf")
+        for label, bs in _splash_candidates(S):
+            try:
+                kern = jax.vmap(
+                    _sp.splash_attention_kernel.make_splash_mqa_single_device(
+                        gqa_mask, block_sizes=bs, interpret=SMOKE
+                    )
+                )
 
-        def splash_gqa_loss(q, k, v):
-            o = gqa_kernel(q, k, v)
-            return jnp.sum(o.astype(jnp.float32) * wg.astype(jnp.float32))
+                def splash_gqa_fwd(q, kern=kern):
+                    return kern(q, kg, vg).astype(jnp.bfloat16)
 
-        ms = do_bench_scan_slope(splash_gqa_fwd, qg, lengths=ATT_LENGTHS,
-                                 verbose=True)
-        record("splash_gqa_fwd", ms, fwd_flops, lengths=ATT_LENGTHS)
-        g = jax.grad(splash_gqa_loss, argnums=(0, 1, 2))
-        step = make_consume_all_grads_body(
-            lambda q: g(q, kg, vg), jnp.bfloat16
-        )
-        msb = do_bench_scan_slope(step, qg, lengths=ATT_LENGTHS,
-                                  verbose=True)
-        record("splash_gqa_fwdbwd", msb, fwd_flops * 3.5,
-               lengths=ATT_LENGTHS)
+                ms = do_bench_scan_slope(splash_gqa_fwd, qg,
+                                         lengths=ATT_LENGTHS, verbose=True)
+                record(f"splash_gqa_fwd_{label}", ms, fwd_flops,
+                       lengths=ATT_LENGTHS,
+                       extra={"splash_config": label})
+                if ms < best_ms:
+                    best_label, best_kernel, best_ms = label, kern, ms
+            except Exception as e:
+                print(f"splash gqa {label}: FAIL {type(e).__name__}: "
+                      f"{str(e)[:200]}", flush=True)
+        if best_kernel is not None:
+            # canonical probe names carry the winner (ratio tooling keys
+            # on them); splash_config records WHICH config won
+            record("splash_gqa_fwd", best_ms, fwd_flops,
+                   lengths=ATT_LENGTHS,
+                   extra={"splash_config": best_label})
+
+            def splash_gqa_loss(q, k, v):
+                o = best_kernel(q, k, v)
+                return jnp.sum(
+                    o.astype(jnp.float32) * wg.astype(jnp.float32)
+                )
+
+            g = jax.grad(splash_gqa_loss, argnums=(0, 1, 2))
+            step = make_consume_all_grads_body(
+                lambda q: g(q, kg, vg), jnp.bfloat16
+            )
+            msb = do_bench_scan_slope(step, qg, lengths=ATT_LENGTHS,
+                                      verbose=True)
+            record("splash_gqa_fwdbwd", msb, fwd_flops * 3.5,
+                   lengths=ATT_LENGTHS,
+                   extra={"splash_config": best_label})
     except Exception as e:
         print(f"splash gqa: FAIL {type(e).__name__}: {str(e)[:200]}",
               flush=True)
@@ -284,31 +332,52 @@ def main():
         sp_mask = _sp.MultiHeadMask(
             [_sp.CausalMask((S, S)) for _ in range(H)]
         )
-        sp_kernel = _sp.splash_attention_kernel.make_splash_mha_single_device(
-            sp_mask, interpret=SMOKE
-        )
         qsp = jnp.asarray(rng.standard_normal((H, S, D)), jnp.bfloat16)
         ksp = jnp.asarray(rng.standard_normal((H, S, D)), jnp.bfloat16)
         vsp = jnp.asarray(rng.standard_normal((H, S, D)), jnp.bfloat16)
         wsp = jnp.asarray(rng.standard_normal((H, S, D)), jnp.bfloat16)
 
-        def splash_fwd(q):
-            return sp_kernel(q, ksp, vsp).astype(jnp.bfloat16)
+        best_label, best_kernel, best_ms = None, None, float("inf")
+        for label, bs in _splash_candidates(S):
+            try:
+                kern = (
+                    _sp.splash_attention_kernel.make_splash_mha_single_device(
+                        sp_mask, block_sizes=bs, interpret=SMOKE
+                    )
+                )
 
-        def splash_loss(q, k, v):
-            o = sp_kernel(q, k, v)
-            return jnp.sum(o.astype(jnp.float32) * wsp.astype(jnp.float32))
+                def splash_fwd(q, kern=kern):
+                    return kern(q, ksp, vsp).astype(jnp.bfloat16)
 
-        ms = do_bench_scan_slope(splash_fwd, qsp, lengths=ATT_LENGTHS,
-                                 verbose=True)
-        record("splash_fwd", ms, ab_flops, lengths=ATT_LENGTHS)
-        g = jax.grad(splash_loss, argnums=(0, 1, 2))
-        step = make_consume_all_grads_body(
-            lambda q: g(q, ksp, vsp), jnp.bfloat16
-        )
-        msb = do_bench_scan_slope(step, qsp, lengths=ATT_LENGTHS,
-                                  verbose=True)
-        record("splash_fwdbwd", msb, ab_flops * 3.5, lengths=ATT_LENGTHS)
+                ms = do_bench_scan_slope(splash_fwd, qsp,
+                                         lengths=ATT_LENGTHS, verbose=True)
+                record(f"splash_fwd_{label}", ms, ab_flops,
+                       lengths=ATT_LENGTHS,
+                       extra={"splash_config": label})
+                if ms < best_ms:
+                    best_label, best_kernel, best_ms = label, kern, ms
+            except Exception as e:
+                print(f"splash {label}: FAIL {type(e).__name__}: "
+                      f"{str(e)[:200]}", flush=True)
+        if best_kernel is not None:
+            record("splash_fwd", best_ms, ab_flops, lengths=ATT_LENGTHS,
+                   extra={"splash_config": best_label})
+
+            def splash_loss(q, k, v):
+                o = best_kernel(q, k, v)
+                return jnp.sum(
+                    o.astype(jnp.float32) * wsp.astype(jnp.float32)
+                )
+
+            g = jax.grad(splash_loss, argnums=(0, 1, 2))
+            step = make_consume_all_grads_body(
+                lambda q: g(q, ksp, vsp), jnp.bfloat16
+            )
+            msb = do_bench_scan_slope(step, qsp, lengths=ATT_LENGTHS,
+                                      verbose=True)
+            record("splash_fwdbwd", msb, ab_flops * 3.5,
+                   lengths=ATT_LENGTHS,
+                   extra={"splash_config": best_label})
     except Exception as e:
         print(f"splash: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
 

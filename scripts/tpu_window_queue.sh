@@ -80,6 +80,21 @@ run_queue() {
     run_step 900 ".tpu_logs/${TS}_smoke.log" python -u scripts/tpu_smoke.py || return
     grep -q "^SMOKE PASS" ".tpu_logs/${TS}_smoke.log" && touch "$SMOKE_STAMP"
   fi
+  # GQA-packed dkv backward A/B — THE decisive measurement for this
+  # round's tentpole. Pre-registered expectation: packed dkv lifts GQA
+  # fwd+bwd to >= 110 TF/s reference-convention (r5 baseline 77.3 TF/s;
+  # fwd pack measured 138). 2x2 arms (dkv_pack x tiling) all append to
+  # bwd_override_sweep.csv; the env-tiling pair runs first because it
+  # isolates the kernel change.
+  run_step 1500 ".tpu_logs/${TS}_bwd_dkvpack_on.log" python -u benchmarks/kernel_bench.py \
+    --seqlens 8192 --backward --bwd-sweep --dkv-pack on || return
+  run_step 1500 ".tpu_logs/${TS}_bwd_dkvpack_off.log" python -u benchmarks/kernel_bench.py \
+    --seqlens 8192 --backward --bwd-sweep --dkv-pack off || return
+  # per-slice (per-pass) tile policy arms of the same sweep
+  run_step 1500 ".tpu_logs/${TS}_bwd_auto_dkvpack_on.log" python -u benchmarks/kernel_bench.py \
+    --seqlens 8192 --backward --bwd-sweep --auto-tile --dkv-pack on || return
+  run_step 1500 ".tpu_logs/${TS}_bwd_auto_dkvpack_off.log" python -u benchmarks/kernel_bench.py \
+    --seqlens 8192 --backward --bwd-sweep --auto-tile --dkv-pack off || return
   # BASELINE config 5 rank-shard: the kernel-side half of the 1M cp=32
   # north-star claim — the round's top unmeasured evidence (the 08:29
   # window's attempt crashed on captured-constant operands, since fixed)

@@ -194,3 +194,61 @@ def test_explicit_blocks_override_auto(monkeypatch):
     ffa_mod.ffa_attn(q, k, v, [[0, s]], [[0, s]], [1],
                      block_q=64, block_k=128)
     assert calls and all(c == (64, 128) for c in calls), calls
+
+
+def test_count_t_matches_builder_on_random_slices():
+    """count_ffa_work_t (the k-major scorer the dkv pass uses) ==
+    build_ffa_plan's num_work_t across random band-slice sets/tilings."""
+    from magiattention_tpu.kernels.ffa_plan import build_ffa_plan
+    from magiattention_tpu.kernels.tile_policy import count_ffa_work_t
+
+    rng = np.random.default_rng(1)
+    for trial in range(20):
+        s = int(rng.integers(100, 1200))
+        n = int(rng.integers(1, 6))
+        qr, kr, tm = [], [], []
+        for _ in range(n):
+            a, b = np.sort(rng.integers(0, s, 2))
+            c, e = np.sort(rng.integers(0, s, 2))
+            qr.append([a, b + 1])
+            kr.append([c, e + 1])
+            tm.append(int(rng.integers(0, 4)))
+        qrn, krn, lo, hi = _bands(qr, kr, tm)
+        for bq, bk in [(64, 128), (128, 256), (256, 512)]:
+            plan = build_ffa_plan(qrn, krn, lo, hi, s, s, bq, bk)
+            cnt = count_ffa_work_t(qrn, krn, lo, hi, s, s, bq, bk)
+            assert cnt == plan.num_work_t, (
+                trial, s, qr, kr, tm, bq, bk, cnt, plan.num_work_t
+            )
+
+
+def test_per_pass_choice_thin_band_and_divisibility():
+    """The per-pass chooser: thin bands pick a smaller block_k than dense
+    full, and any bwd pick divides the fwd-padded geometry (the
+    resolve_bwd_overrides gate must never silently drop a policy pick)."""
+    from magiattention_tpu.kernels.tile_policy import (
+        _round_up, choose_blocks_per_pass,
+    )
+
+    s = 8192
+    qr = np.array([[0, s]], np.int32)
+    kr = np.array([[0, s]], np.int32)
+    lo = np.array([-256], np.int32)
+    hi = np.array([0], np.int32)
+    fwd, dq, dkv = choose_blocks_per_pass(qr, kr, lo, hi, s, s, 128, 128)
+    qrd, krd, lod, hid = _bands([[0, s]], [[0, s]], [0])
+    fwd_d, dq_d, dkv_d = choose_blocks_per_pass(
+        qrd, krd, lod, hid, s, s, 128, 128
+    )
+    # thin band: block_k no larger than the dense pick, for every pass
+    assert fwd[1] <= fwd_d[1]
+    for pick, dense_pick, fwd_pick in ((dq, dq_d, fwd), (dkv, dkv_d, fwd_d)):
+        eff = pick or fwd
+        eff_d = dense_pick or fwd_d
+        assert eff[1] <= eff_d[1]
+    # divisibility contract vs the fwd-padded geometry
+    for f, picks in ((fwd, (dq, dkv)), (fwd_d, (dq_d, dkv_d))):
+        sqp, skp = _round_up(s, f[0]), _round_up(s, f[1])
+        for p in picks:
+            if p is not None:
+                assert sqp % p[0] == 0 and skp % p[1] == 0, (f, p)

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from magiattention_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from magiattention_tpu.common.range import AttnRange

@@ -68,3 +68,39 @@ def test_report_without_history_is_empty(history_dir):
 def test_append_never_raises(history_dir, monkeypatch):
     monkeypatch.setattr(pr, "HISTORY_DIR", "/proc/definitely/not/writable")
     assert pr.append_row("k", {"a": 1}) == ""
+
+
+def test_fwdbwd_floor_uses_executed_flops():
+    """The fwd+bwd credibility floor must be computed from EXECUTED flops
+    (4.5x fwd) — a reference-convention (3.5x) floor sits ~29% below the
+    physical bound and waves through unphysical slopes (ADVICE r5 #1).
+
+    Synthetic slope just above the executed-flops ceiling in model terms
+    (~162 model-TF/s at the 208 TF/s anchor; the canonical "160 TF/s"
+    example assumed the nominal 197 peak): the hardware would have to run
+    its 4.5x matmul work above the measured chip ceiling, so the executed
+    floor flags it — while the old 3.5x floor (model rate vs ceiling,
+    162 < 208) passed it.
+    """
+    fwd_flops = 4 * (8192 * 8193 // 2) * 128 * 16  # the bench GQA shape
+    flops_ref = fwd_flops * 3.5
+    flops_hw = flops_ref * pr.HW_FWD_BWD_RATIO
+    # model-convention rate 2% above the executed-flops ceiling
+    model_tflops = (
+        pr.MEASURED_CEILING_TFLOPS / pr.HW_FWD_BWD_RATIO
+    ) * 1.02
+    slope_ms = flops_ref / (model_tflops * 1e9)
+
+    old_floor = pr.credible_floor_ms(flops_ref)   # 3.5x convention
+    new_floor = pr.credible_floor_ms(flops_hw)    # executed flops
+    assert slope_ms > old_floor, "old floor should have passed this slope"
+    assert slope_ms < new_floor, "executed-flops floor must flag it"
+    # the implied EXECUTED rate really is above the measured ceiling
+    implied_hw = flops_hw / (slope_ms * 1e-3) / 1e12
+    assert implied_hw > pr.MEASURED_CEILING_TFLOPS
+    # and a genuinely physical slope (model rate at 80% of the executed
+    # ceiling) clears the new floor
+    ok_ms = flops_ref / (
+        0.8 * pr.MEASURED_CEILING_TFLOPS / pr.HW_FWD_BWD_RATIO * 1e9
+    )
+    assert ok_ms > new_floor

@@ -55,12 +55,15 @@ def test_causal_full_rate_ratio_near_one():
     predicted causal/full TFLOP/s ratio is ~1 at the grid seqlen (4096;
     at much smaller seqlens tile-granularity padding legitimately drops
     the causal rate — the corollary is a statement about the published
-    configs, not all shapes)."""
+    configs, not all shapes). Lower bound 0.80: anchoring AMBIENT to the
+    measured 208 TF/s ceiling (vs the tunnel-era 0.957 derate) speeds the
+    compute floor enough that causal fwd at 4096 crosses into being
+    HBM-bound, where its tile-padding traffic costs a few percent."""
     full = {r["phase"]: r for r in _rows(0, s=4096)}
     caus = {r["phase"]: r for r in _rows(1, s=4096)}
     for phase in ("fwd", "fwdbwd"):
         ratio = caus[phase]["tf_hi"] / full[phase]["tf_hi"]
-        assert 0.85 <= ratio <= 1.1, (phase, ratio)
+        assert 0.80 <= ratio <= 1.1, (phase, ratio)
 
 
 def test_fwdbwd_slower_than_fwd_but_more_flops():
