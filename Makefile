@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead
+.PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
+	telemetry-smoke
 
 test:  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -28,3 +29,6 @@ queue:  ## background chip-window experiment poller
 
 fit-overhead:  ## fit tile_policy.OVERHEAD_ELEMS from recorded sweeps
 	$(PY) scripts/fit_tile_overhead.py
+
+telemetry-smoke:  ## CPU single-step telemetry round trip (JSONL -> report)
+	$(PY) -m pytest tests/test_support/test_telemetry.py -x -q

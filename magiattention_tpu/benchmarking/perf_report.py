@@ -88,6 +88,15 @@ def append_row(name: str, row: dict) -> str:
             "commit": _git_rev(),
             **row,
         }
+        # MAGI_ATTENTION_TELEMETRY=1: stamp the row with the run's comm /
+        # balance context (tel_* columns) so a perf number carries the plan
+        # that produced it. Empty dict (no extra columns) when off.
+        from .. import telemetry
+
+        full.update(
+            {k: v for k, v in telemetry.flat_summary().items()
+             if k not in full}
+        )
         rows: list[dict] = []
         header: list[str] = []
         if os.path.exists(path):

@@ -37,6 +37,7 @@ import numpy as np
 from ..common.range import AttnRange
 from ..common.ranges import AttnRanges
 from .primitives import group_cast_rows
+from .. import telemetry
 
 
 def _round_up(x: int, m: int) -> int:
@@ -233,7 +234,7 @@ def make_hier_group_cast_plan(
             cat = np.concatenate(chunks)
             b_recv_sel[d, : len(cat)] = cat
 
-    return HierGroupCastPlan(
+    plan = HierGroupCastPlan(
         n_outer=n_outer,
         n_inner=n_inner,
         a_send_idx=a_send_idx,
@@ -244,6 +245,33 @@ def make_hier_group_cast_plan(
         r_max=r_max,
         a_recv_len=a_rows,
     )
+    if telemetry.enabled():
+        # flat baseline: every cross-node (dst, src) request row crosses DCN
+        # once per destination RANK; dcn_rows dedups to once per node
+        flat_dcn = sum(
+            requests[d][s].total_seqlen
+            for d in range(cp)
+            for s in range(cp)
+            if node[s] != node[d]
+        )
+        telemetry.record_event(
+            "hier_plan",
+            n_outer=n_outer,
+            n_inner=n_inner,
+            cp_size=cp,
+            a_cap=int(a_cap),
+            b_cap=int(b_cap),
+            r_max=int(r_max),
+            dcn_rows=plan.dcn_rows(),
+            flat_dcn_rows=int(flat_dcn),
+            dcn_dedup_ratio=(
+                flat_dcn / plan.dcn_rows() if plan.dcn_rows() else 1.0
+            ),
+            a_wire_rows=cp * n_outer * int(a_cap),
+            b_wire_rows=cp * n_inner * int(b_cap),
+            final_rows=int(final_rows.sum()),
+        )
+    return plan
 
 
 def hier_group_cast_rows(

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.profiling import profile_scope
+
 
 def group_cast_rows(
     x: jax.Array,
@@ -158,34 +160,40 @@ def group_reduce_rows_pp(
 
 def cast_rows(x, ops, kind, axis_name):
     """Lowering dispatcher. kind is one of ("a2a",),
-    ("pp", deltas, caps, cp), or ("ragged", r_cap)."""
-    if kind[0] == "pp":
-        return group_cast_rows_pp(
-            x, ops[0], ops[1], kind[1], kind[2], kind[3], axis_name
-        )
-    if kind[0] == "ragged":
-        return group_cast_rows_ragged(
-            x, ops[0], ops[1], ops[2], ops[3], ops[4], kind[1], axis_name
-        )
-    return group_cast_rows(x, ops[0], ops[1], axis_name)
+    ("pp", deltas, caps, cp), or ("ragged", r_cap).
+
+    The per-lowering ``group_cast_<kind>`` xprof span (gated on
+    MAGI_ATTENTION_PROFILE_MODE) is what the telemetry records' per-stage
+    ``lowering_executed`` fields line up with in a trace."""
+    with profile_scope(f"group_cast_{kind[0]}"):
+        if kind[0] == "pp":
+            return group_cast_rows_pp(
+                x, ops[0], ops[1], kind[1], kind[2], kind[3], axis_name
+            )
+        if kind[0] == "ragged":
+            return group_cast_rows_ragged(
+                x, ops[0], ops[1], ops[2], ops[3], ops[4], kind[1], axis_name
+            )
+        return group_cast_rows(x, ops[0], ops[1], axis_name)
 
 
 def reduce_rows(y, ops, kind, axis_name, shard_len):
     """Transpose dispatcher of :func:`cast_rows`."""
-    if kind[0] == "pp":
-        return group_reduce_rows_pp(
-            y, ops[0], ops[1], kind[1], kind[2], kind[3], axis_name,
-            shard_len,
-        )
-    if kind[0] == "ragged":
-        # the exact transpose via jax's own ragged_all_to_all transpose
-        # rule — no hand-maintained mirror plan to drift out of sync
-        zeros = jnp.zeros((shard_len, *y.shape[1:]), y.dtype)
-        _, vjp = jax.vjp(
-            lambda x: cast_rows(x, ops, kind, axis_name), zeros
-        )
-        return vjp(y)[0]
-    return group_reduce_rows(y, ops[0], ops[1], axis_name, shard_len)
+    with profile_scope(f"group_reduce_{kind[0]}"):
+        if kind[0] == "pp":
+            return group_reduce_rows_pp(
+                y, ops[0], ops[1], kind[1], kind[2], kind[3], axis_name,
+                shard_len,
+            )
+        if kind[0] == "ragged":
+            # the exact transpose via jax's own ragged_all_to_all transpose
+            # rule — no hand-maintained mirror plan to drift out of sync
+            zeros = jnp.zeros((shard_len, *y.shape[1:]), y.dtype)
+            _, vjp = jax.vjp(
+                lambda x: cast_rows(x, ops, kind, axis_name), zeros
+            )
+            return vjp(y)[0]
+        return group_reduce_rows(y, ops[0], ops[1], axis_name, shard_len)
 
 
 def group_cast_rows_ragged(

@@ -17,6 +17,7 @@ from typing import Any
 import jax
 from jax.sharding import Mesh
 
+from . import telemetry
 from .common.enum import AttnMaskType
 from .common.ranges import AttnRanges
 from .config import DistAttnConfig
@@ -117,6 +118,22 @@ class DistAttnRuntimeMgr:
             self.runtime = DynamicDistAttnRuntime(
                 plan=self.dynamic_plan, mesh=mesh, cp_axis=key.cp_axis
             )
+            if telemetry.enabled():
+                p = self.dynamic_plan
+                telemetry.record_event(
+                    "plan_build",
+                    planner="dynamic",
+                    cp_size=key.cp_size,
+                    overlap_degree=1,
+                    stages=[
+                        {"name": name, **cast.telemetry_dict()}
+                        for name, cast in (
+                            ("q_cast", p.q_cast),
+                            ("kv_cast", p.kv_cast),
+                            ("ret", p.ret),
+                        )
+                    ],
+                )
             return
 
         self.dynamic_plan = None
@@ -135,45 +152,65 @@ class DistAttnRuntimeMgr:
             # forced single merged kernel when disabled
             use_overlap=None if overlap_cfg.enable else False,
         )
-        self._log_comm_plan()
+        self._record_comm_plan()
 
-    def _log_comm_plan(self) -> None:
-        """INFO-dump the comm plan at init (ref dist_attn_runtime_mgr.py:
-        673-1033 meta dumps + comm_meta.py:86-155 send/recv token counts):
-        per-stage payload rows, wire rows, padding ratio, chosen lowering."""
-        import logging
-
-        logger = logging.getLogger("magiattention_tpu.runtime")
-        if not logger.isEnabledFor(logging.INFO):
-            return
-        cm = self.comm_meta
-        # the runtime may override the solver's portable lowering with the
-        # backend-dependent ragged/hier tier — report what actually runs
+    def _stage_telemetry_dicts(self) -> list[dict]:
+        """Per-stage comm summaries with the EXECUTED lowering: the runtime
+        may override the solver's portable choice with the backend-dependent
+        ragged/hier tier — report what actually runs."""
         kinds = getattr(self.runtime, "_cast_kinds", None)
         names = {"pp": "ppermute", "a2a": "a2a", "ragged": "ragged",
                  "hier": "hier"}
-        for st, s in enumerate(cm.kv_stages):
+        out = []
+        for st, s in enumerate(self.comm_meta.kv_stages):
             executed = (
                 names.get(kinds[st][0], kinds[st][0])
                 if kinds and st < len(kinds)
                 else s.lowering
             )
-            if executed == "ragged":
-                wire = s.payload_rows()
-            elif executed == s.lowering:
-                wire = s.wire_rows()
-            else:  # e.g. hier: flat wire numbers would be misleading
-                wire = s.wire_rows(s.lowering)
-            logger.info(
-                "comm plan stage %d/%d: executed=%s planned=%s "
-                "payload_rows=%d wire_rows=%d ratio=%.3f (a2a would be %d) "
-                "a_cap=%d r_max=%d per-rank send rows=%s recv rows=%s",
-                st, len(cm.kv_stages), executed, s.lowering,
-                s.payload_rows(), wire,
-                wire / max(s.payload_rows(), 1), s.wire_rows("a2a"),
-                s.a_cap, s.r_max, s.send_counts.sum(axis=1).tolist(),
-                s.recv_len.tolist(),
+            out.append(
+                {
+                    "stage": st,
+                    "xprof_scope": f"group_cast_stage{st}",
+                    **s.telemetry_dict(executed=executed),
+                }
             )
+        return out
+
+    def _record_comm_plan(self) -> None:
+        """The init-time comm-plan dump (ref dist_attn_runtime_mgr.py:
+        673-1033 meta dumps + comm_meta.py:86-155 send/recv token counts):
+        per-stage payload rows, wire rows, padding ratio, chosen lowering —
+        emitted to the telemetry registry when MAGI_ATTENTION_TELEMETRY=1
+        and to the INFO log when enabled (one source of numbers for both)."""
+        import logging
+
+        logger = logging.getLogger("magiattention_tpu.runtime")
+        log_on = logger.isEnabledFor(logging.INFO)
+        if not (log_on or telemetry.enabled()):
+            return
+        stages = self._stage_telemetry_dicts()
+        if telemetry.enabled():
+            telemetry.record_event(
+                "plan_build",
+                planner="static",
+                cp_size=self.key.cp_size,
+                overlap_degree=self.comm_meta.overlap_degree,
+                stages=stages,
+            )
+        if log_on:
+            for d in stages:
+                logger.info(
+                    "comm plan stage %d/%d: executed=%s planned=%s "
+                    "payload_rows=%d wire_rows=%d ratio=%.3f (a2a would be "
+                    "%d) a_cap=%d r_max=%d per-rank send rows=%s recv "
+                    "rows=%s",
+                    d["stage"], len(stages), d["lowering_executed"],
+                    d["lowering_planned"], d["payload_rows"], d["wire_rows"],
+                    d["wire_ratio"], d["a2a_wire_rows"], d["a_cap"],
+                    d["r_max"], d["send_rows_per_rank"],
+                    d["recv_rows_per_rank"],
+                )
 
     # -- ops ---------------------------------------------------------------
 
@@ -286,21 +323,48 @@ class DistAttnRuntimeDict:
     def __init__(self, maxsize: int | None = None) -> None:
         self.maxsize = maxsize or env_general.runtime_dict_size()
         self._d: OrderedDict[DistAttnRuntimeKey, DistAttnRuntimeMgr] = OrderedDict()
+        # plain int counters: always maintained (no timers / file I/O, so
+        # the telemetry-off contract holds); exported via get_stats() and,
+        # when MAGI_ATTENTION_TELEMETRY=1, mirrored into the registry
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
 
     def get_or_create(
         self, key: DistAttnRuntimeKey, mesh: Mesh
     ) -> DistAttnRuntimeMgr:
         if key in self._d:
             self._d.move_to_end(key)
+            self._hits += 1
+            telemetry.inc("runtime_cache.hit")
             return self._d[key]
-        mgr = DistAttnRuntimeMgr(key, mesh)
+        self._misses += 1
+        telemetry.inc("runtime_cache.miss")
+        with telemetry.stage_timer("runtime_mgr_init"):
+            mgr = DistAttnRuntimeMgr(key, mesh)
         self._d[key] = mgr
         while len(self._d) > self.maxsize:
             self._d.popitem(last=False)
+            self._evictions += 1
+            telemetry.inc("runtime_cache.evict")
+        if telemetry.enabled():
+            telemetry.record_event("runtime_cache", **self.get_stats())
         return mgr
 
     def get(self, key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr | None:
         return self._d.get(key)
+
+    def get_stats(self) -> dict[str, int]:
+        """Cache behavior counters (the cache is keyed on mask + mesh +
+        config + ENV_KEYS_AFFECTING_RUNTIME snapshot, so a surprise miss
+        rate usually means env flags are churning between steps)."""
+        return {
+            "hits": self._hits,
+            "misses": self._misses,
+            "evictions": self._evictions,
+            "size": len(self._d),
+            "maxsize": self.maxsize,
+        }
 
     def clear(self) -> None:
         self._d.clear()

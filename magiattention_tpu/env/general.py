@@ -47,6 +47,20 @@ def is_profile_mode_enable() -> bool:
     return _get_bool("MAGI_ATTENTION_PROFILE_MODE")
 
 
+def is_telemetry_enable() -> bool:
+    """Record runtime telemetry (telemetry/ registry): dispatch balance,
+    per-stage comm volumes, plan/step timings, cache stats — exported as
+    JSONL. Off by default: zero overhead on the hot path, same contract as
+    MAGI_ATTENTION_PROFILE_MODE."""
+    return _get_bool("MAGI_ATTENTION_TELEMETRY")
+
+
+def telemetry_dir() -> str:
+    """Directory for telemetry JSONL files (one per process,
+    ``magiattention-<pid>.jsonl``); read by telemetry/registry.py."""
+    return _get_str("MAGI_ATTENTION_TELEMETRY_DIR", "telemetry")
+
+
 def is_range_merge_enable() -> bool:
     """Merge band-compatible adjacent slices before kernel planning
     (kernels/ffa_plan.py build_ffa_plan -> mask_utils.merge_band_slices;

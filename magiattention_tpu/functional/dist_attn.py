@@ -51,6 +51,7 @@ from ..meta.collection.calc_meta import AttnArg, CalcMeta
 from ..meta.collection.comm_meta import CommMeta
 from ..utils.profiling import instrument_scope, profile_scope
 from .utils import lse_weighted_reduce
+from .. import telemetry
 
 
 def _head_major(x: jax.Array, sp: int) -> jax.Array:
@@ -480,11 +481,13 @@ class DistAttnRuntime(DeferredTilePolicy):
         trace-local tracers on ``self`` would leak them into later traces.
         """
         with jax.ensure_compile_time_eval():
-            self._build_plans_impl(blk_q, blk_k)
+            with telemetry.stage_timer("build_plans"):
+                self._build_plans_impl(blk_q, blk_k)
 
     def _build_plans_impl(self, blk_q, blk_k) -> None:
         from ..kernels.ffa import default_blocks
 
+        self._tel_plan_groups = None  # recomputed per plan build
         km = self.calc_meta
         shard = km.shard_len
         kv_shard = km.kv_shard_len
@@ -518,6 +521,95 @@ class DistAttnRuntime(DeferredTilePolicy):
                 )
                 self._stage_arrays.append(sa)
                 self._stage_dims.append(sdims)
+        if telemetry.enabled():
+            self._plan_group_stats()
+
+    def _plan_group_stats(self) -> list[dict]:
+        """Padded-grid work accounting per executed kernel group, cached for
+        the attn_step record (the per-plan ``ffa_plan`` records carry the
+        same numbers at build; caching here lets every step report estimated
+        vs executed work without re-walking the plans)."""
+        km = self.calc_meta
+        cp = self.cp_size
+
+        def grp(name, dims, bq, bk):
+            w = dims[2]  # rank-uniform padded work-item count
+            return {
+                "name": name, "block_q": bq, "block_k": bk, "num_work": w,
+                "padded_elems": cp * w * bq * bk,
+            }
+
+        bq, bk = self._bq, self._bk
+        if self.use_overlap:
+            groups = [grp("host", self._host_dims, bq,
+                          min(bk, _ceil_to(km.kv_shard_len, 128)))]
+            for st, d in enumerate(self._stage_dims):
+                rl = km.recv_len_per_stage[st]
+                groups.append(
+                    grp(f"stage{st}", d, bq, min(bk, _ceil_to(rl, 128)))
+                )
+        else:
+            groups = [grp("merged", self._merged_dims, bq, bk)]
+        self._tel_plan_groups = groups
+        self._tel_band_elems = sum(
+            telemetry.band_area(a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
+            for a in km.merged_args
+        )
+        return groups
+
+    def _attn_step_payload(self, q, k, v) -> dict:
+        """One attention step's telemetry payload (callers gate on
+        ``telemetry.enabled()``). Comm rows were planned dtype-blind; bytes
+        resolve here where head dims and dtypes are known — k and v rows
+        ride one fused collective, so a wire row carries both."""
+        sq, hq, dh = q.shape
+        _, hk, dv = v.shape
+        row_bytes = hk * dh * k.dtype.itemsize + hk * dv * v.dtype.itemsize
+        exec_map = {"pp": "ppermute", "a2a": "a2a", "ragged": "ragged",
+                    "hier": "hier"}
+        stages = []
+        payload_total = wire_total = 0
+        for st, s in enumerate(self.comm_meta.kv_stages):
+            d = s.telemetry_dict(executed=exec_map[self._cast_kinds[st][0]])
+            d["stage"] = st
+            d["xprof_scope"] = f"group_cast_stage{st}"
+            d["payload_bytes"] = d["payload_rows"] * row_bytes
+            d["wire_bytes"] = d["wire_rows"] * row_bytes
+            d["padding_bytes"] = d["padding_rows"] * row_bytes
+            payload_total += d["payload_bytes"]
+            wire_total += d["wire_bytes"]
+            stages.append(d)
+        payload = {
+            "backend": self.backend,
+            "cp_size": self.cp_size,
+            "overlap_degree": self.num_stages,
+            "use_overlap": self.use_overlap,
+            "seqlen_q_shard": sq,
+            "heads_q": hq, "head_dim": dh, "heads_kv": hk, "head_dim_v": dv,
+            "dtype": q.dtype.name,
+            "row_bytes": row_bytes,
+            "stages": stages,
+            "payload_bytes_total": payload_total,
+            "wire_bytes_total": wire_total,
+            "padding_bytes_total": wire_total - payload_total,
+        }
+        # kernel-plan work accounting (absent on the sdpa backends when the
+        # deferred auto-tile policy never ran, i.e. no FFA plans exist)
+        if getattr(self, "_bq", None) is not None:
+            if getattr(self, "_tel_plan_groups", None) is None:
+                self._plan_group_stats()  # telemetry enabled after build
+            band = self._tel_band_elems
+            padded = sum(g["padded_elems"] for g in self._tel_plan_groups)
+            payload.update(
+                block_q=self._bq, block_k=self._bk,
+                plan_groups=self._tel_plan_groups,
+                band_elems=band,
+                padded_elems=padded,
+                # fwd FLOPs, FlashAttention-2 convention (perf_report.py)
+                est_flops_fwd=4 * band * dh * hq,
+                padded_flops_fwd=4 * padded * dh * hq,
+            )
+        return payload
 
     def _tile_geoms(self):
         # per-mask tile choice scored on the merged per-rank geometries
@@ -632,6 +724,31 @@ class DistAttnRuntime(DeferredTilePolicy):
             (out ``(cp*shard, hq, dv)``, lse ``(cp*shard, hq)`` fp32), same
             sharded layout; plus max_logits when requested.
         """
+        if not telemetry.enabled():
+            return self._calc_attn_impl(q, k, v, return_max_logits)
+        # wall_ms spans dispatch + (on first call) trace/compile; per-stage
+        # DEVICE time lives in the xprof spans the stages' xprof_scope
+        # fields name (docs/observability.md)
+        with telemetry.stage_timer("calc_attn"):
+            result = self._calc_attn_impl(q, k, v, return_max_logits)
+        wall_ms = telemetry.get_collector().gauges.get(
+            "time.calc_attn.last_ms"
+        )
+        telemetry.record_event(
+            "attn_step",
+            xprof_scope="DistAttnRuntime.calc_attn",
+            wall_ms=wall_ms,
+            **self._attn_step_payload(q, k, v),
+        )
+        return result
+
+    def _calc_attn_impl(
+        self,
+        q: jax.Array,
+        k: jax.Array,
+        v: jax.Array,
+        return_max_logits: bool = False,
+    ):
         sq, hq, dh = q.shape
         _, hk, dv = v.shape
         group = hq // hk

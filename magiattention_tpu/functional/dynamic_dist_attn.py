@@ -46,6 +46,7 @@ from ..meta.collection.dynamic_meta import DynamicAttnPlan
 from ..utils.profiling import instrument_scope, profile_scope
 from .dist_attn import DeferredTilePolicy, _head_major, _stack_plans
 from .utils import lse_weighted_reduce
+from .. import telemetry
 
 NEG_INF = float("-inf")
 
@@ -230,7 +231,8 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
     def _build_plans(self, blk_q, blk_k) -> None:
         # may run inside a jit trace (deferred auto-tile): force the plan
         # constants concrete so no tracer is cached on self
-        with jax.ensure_compile_time_eval():
+        with jax.ensure_compile_time_eval(), \
+                telemetry.stage_timer("build_plans"):
             p = self.plan
             bq, bk = default_blocks(p.q_buf_len, p.k_buf_len, blk_q, blk_k)
             self._bq, self._bk = bq, bk
@@ -239,6 +241,73 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
                 p.attn_args, p.q_buf_len, p.k_buf_len, bq, bk,
                 policy_dq=pol_dq, policy_dkv=pol_dkv,
             )
+
+    def _attn_step_payload(self, q, k, v) -> dict:
+        """One qo-comm step's telemetry payload (callers gate on
+        ``telemetry.enabled()``). Per-stage row bytes differ: q rows, fused
+        k|v rows, and returned partial out+lse rows each have their own
+        width, resolved here where dtypes/head dims are known."""
+        from ..env import comm as env_comm
+
+        p = self.plan
+        sq, hq, dh = q.shape
+        _, hk, dv = v.shape
+        exec_map = {"pp": "ppermute", "a2a": "a2a", "ragged": "ragged"}
+        # partial out rows ride the ret cast in fp32 under the fwd HP reduce
+        out_itemsize = (
+            4 if env_comm.is_fwd_high_precision_reduce_enable()
+            else q.dtype.itemsize
+        )
+        stage_defs = (
+            ("q_cast", p.q_cast, self._q_kind, "qo_comm_cast",
+             hq * dh * q.dtype.itemsize),
+            ("kv_cast", p.kv_cast, self._k_kind, "qo_comm_cast",
+             hk * dh * k.dtype.itemsize + hk * dv * v.dtype.itemsize),
+            ("ret", p.ret, self._r_kind, "ffa_fwd_dyn",
+             hq * dv * out_itemsize + hq * 4),  # + fp32 lse
+        )
+        stages = []
+        payload_total = wire_total = 0
+        for name, cast, kind, scope, row_bytes in stage_defs:
+            d = cast.telemetry_dict(executed=exec_map[kind[0]])
+            d["stage"] = name
+            d["xprof_scope"] = scope
+            d["row_bytes"] = row_bytes
+            d["payload_bytes"] = d["payload_rows"] * row_bytes
+            d["wire_bytes"] = d["wire_rows"] * row_bytes
+            d["padding_bytes"] = d["padding_rows"] * row_bytes
+            payload_total += d["payload_bytes"]
+            wire_total += d["wire_bytes"]
+            stages.append(d)
+        payload = {
+            "planner": "dynamic",
+            "backend": self.backend,
+            "cp_size": self.mesh.shape[self.cp_axis],
+            "overlap_degree": 1,  # qo-comm runs one compute stage
+            "seqlen_q_shard": sq,
+            "heads_q": hq, "head_dim": dh, "heads_kv": hk, "head_dim_v": dv,
+            "dtype": q.dtype.name,
+            "stages": stages,
+            "payload_bytes_total": payload_total,
+            "wire_bytes_total": wire_total,
+            "padding_bytes_total": wire_total - payload_total,
+        }
+        if getattr(self, "_bq", None) is not None:
+            cp = self.mesh.shape[self.cp_axis]
+            w = self._dims[2]
+            padded = cp * w * self._bq * self._bk
+            band = sum(
+                telemetry.band_area(a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
+                for a in p.attn_args
+            )
+            payload.update(
+                block_q=self._bq, block_k=self._bk,
+                band_elems=band,
+                padded_elems=padded,
+                est_flops_fwd=4 * band * dh * hq,
+                padded_flops_fwd=4 * padded * dh * hq,
+            )
+        return payload
 
     def _tile_geoms(self):
         p = self.plan
@@ -270,6 +339,28 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
 
         q/k/v: ``(cp*shard, h, d)`` dispatched layout sharded over cp axis.
         """
+        if not telemetry.enabled():
+            return self._calc_attn_impl(q, k, v, return_max_logits)
+        with telemetry.stage_timer("calc_attn"):
+            result = self._calc_attn_impl(q, k, v, return_max_logits)
+        wall_ms = telemetry.get_collector().gauges.get(
+            "time.calc_attn.last_ms"
+        )
+        telemetry.record_event(
+            "attn_step",
+            xprof_scope="DynamicDistAttnRuntime.calc_attn",
+            wall_ms=wall_ms,
+            **self._attn_step_payload(q, k, v),
+        )
+        return result
+
+    def _calc_attn_impl(
+        self,
+        q: jax.Array,
+        k: jax.Array,
+        v: jax.Array,
+        return_max_logits: bool = False,
+    ):
         p = self.plan
         sq, hq, dh = q.shape
         _, hk, dv = v.shape

@@ -21,6 +21,7 @@ from functools import lru_cache
 import numpy as np
 
 from .mask_utils import BAND_INF
+from .. import telemetry
 from ..utils.profiling import instrument_host
 
 # meta columns per work item
@@ -52,6 +53,36 @@ class FFAPlan:
     @property
     def num_work_t(self) -> int:
         return len(self.work_qt_t)
+
+
+def _record_plan_telemetry(
+    plan: FFAPlan,
+    qr: np.ndarray,
+    kr: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+) -> FFAPlan:
+    """Gated per-build record: the padded grid work the kernel will execute
+    vs the true band area it needed — the estimated-vs-executed FLOP ratio
+    at plan time (multiply elems by 4 * head_dim * num_heads_q for fwd
+    FLOPs; the step record does, once dims are known)."""
+    if telemetry.enabled():
+        padded = plan.num_work * plan.block_q * plan.block_k
+        band = telemetry.band_area(qr, kr, d_lo, d_hi)
+        telemetry.record_event(
+            "ffa_plan",
+            num_slices=len(qr),
+            block_q=plan.block_q,
+            block_k=plan.block_k,
+            num_q_tiles=plan.num_q_tiles,
+            num_k_tiles=plan.num_k_tiles,
+            num_work=plan.num_work,
+            num_work_t=plan.num_work_t,
+            padded_elems=padded,
+            band_elems=band,
+            padding_ratio=padded / band if band else 1.0,
+        )
+    return plan
 
 
 def _band_tile_interaction(
@@ -110,11 +141,15 @@ def build_ffa_plan(
                 q_ranges, k_ranges, d_lo, d_hi,
                 num_q_tiles, num_k_tiles, block_q, block_k, BAND_INF,
             )
-            return FFAPlan(
-                work_qt=arrays[0], work_kt=arrays[1], meta=arrays[2],
-                work_qt_t=arrays[3], work_kt_t=arrays[4], meta_t=arrays[5],
-                num_q_tiles=num_q_tiles, num_k_tiles=num_k_tiles,
-                block_q=block_q, block_k=block_k,
+            return _record_plan_telemetry(
+                FFAPlan(
+                    work_qt=arrays[0], work_kt=arrays[1], meta=arrays[2],
+                    work_qt_t=arrays[3], work_kt_t=arrays[4],
+                    meta_t=arrays[5],
+                    num_q_tiles=num_q_tiles, num_k_tiles=num_k_tiles,
+                    block_q=block_q, block_k=block_k,
+                ),
+                q_ranges, k_ranges, d_lo, d_hi,
             )
         except ImportError:
             if mode == "1":
@@ -196,17 +231,20 @@ def build_ffa_plan(
     work_qt, work_kt, meta = flatten(q_items, major_is_q=True)
     work_qt_t, work_kt_t, meta_t = flatten(k_items, major_is_q=False)
 
-    return FFAPlan(
-        work_qt=work_qt,
-        work_kt=work_kt,
-        meta=meta,
-        work_qt_t=work_qt_t,
-        work_kt_t=work_kt_t,
-        meta_t=meta_t,
-        num_q_tiles=num_q_tiles,
-        num_k_tiles=num_k_tiles,
-        block_q=block_q,
-        block_k=block_k,
+    return _record_plan_telemetry(
+        FFAPlan(
+            work_qt=work_qt,
+            work_kt=work_kt,
+            meta=meta,
+            work_qt_t=work_qt_t,
+            work_kt_t=work_kt_t,
+            meta_t=meta_t,
+            num_q_tiles=num_q_tiles,
+            num_k_tiles=num_k_tiles,
+            block_q=block_q,
+            block_k=block_k,
+        ),
+        q_ranges, k_ranges, d_lo, d_hi,
     )
 
 

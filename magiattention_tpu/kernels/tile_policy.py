@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import telemetry
 from ..env.general import _get_int
 
 NUM_LANES = 128
@@ -194,9 +195,20 @@ def choose_blocks_multi(
         score = w * (bq * bk + OVERHEAD_ELEMS)
         if best_score is None or score < best_score:
             best, best_score = (bq, bk), score
-    return best or (
+    chosen = best or (
         min(256, _round_up(sq, 16)), min(512, _round_up(sk, NUM_LANES))
     )
+    if telemetry.enabled():
+        telemetry.record_event(
+            "tile_policy",
+            mode="fwd_only",
+            sq=sq, sk=sk, d=d, dv=dv, itemsize=itemsize,
+            num_geoms=len(rank_geoms),
+            candidates_scored=len(seen),
+            fwd_blocks=list(chosen),
+            fallback=best is None,
+        )
+    return chosen
 
 
 def choose_blocks(
@@ -316,6 +328,18 @@ def choose_blocks_per_pass_multi(
         dq = None
     if dkv == fwd:
         dkv = None
+    if telemetry.enabled():
+        telemetry.record_event(
+            "tile_policy",
+            mode="per_pass",
+            sq=sq, sk=sk, d=d, dv=dv, itemsize=itemsize,
+            num_geoms=len(rank_geoms),
+            candidates_scored=len(cands),
+            fwd_blocks=list(fwd),
+            # None = inherit fwd (the plan tuple stays at 6 arrays)
+            dq_blocks=list(dq) if dq else None,
+            dkv_blocks=list(dkv) if dkv else None,
+        )
     return fwd, dq, dkv
 
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 import logging
 
 from .. import env as _env
+from .. import telemetry
 from ..common.enum import AttnMaskType, AttnType, DispatchAlgType
 from ..common.range import AttnRange
 from ..common.ranges import AttnRanges
@@ -247,12 +248,15 @@ def make_dispatch_meta_from_qk_ranges(
     )
     areas = bucket.areas_per_chunk
 
+    chosen_alg = dispatch_config.alg
     if preset_partitions is not None:
         # re-keying after dispatch: reuse a prior dispatch solution for a
         # new mask (ref api :1172) — no balance guarantee for the new mask
         partitions = [sorted(p) for p in preset_partitions]
+        chosen_alg = None
     elif cp_size == 1:
         partitions = [list(range(num_chunks))]
+        chosen_alg = None
     elif dispatch_config.alg == DispatchAlgType.AUTO:
         kv_own = None
         if total_seqlen_k != total_seqlen_q:
@@ -268,7 +272,7 @@ def make_dispatch_meta_from_qk_ranges(
                 AttnRanges([AttnRange(r * sz, (r + 1) * sz)])
                 for r in range(cp_size)
             ]
-        partitions, _ = _auto_select_partitions(
+        partitions, chosen_alg = _auto_select_partitions(
             bucket, areas, cp_size, num_chunks, dispatch_config,
             kv_own_ranges=kv_own,
         )
@@ -276,6 +280,32 @@ def make_dispatch_meta_from_qk_ranges(
         partitions = _solve_partitions_with_alg(
             bucket, areas, cp_size, num_chunks, dispatch_config,
             dispatch_config.alg,
+        )
+
+    if telemetry.enabled():
+        # the CHOSEN assignment (the dispatch_solve kinds above are per
+        # candidate/algorithm; the native minheap path bypasses them)
+        per_rank = [sum(areas[c] for c in p) for p in partitions]
+        max_area = max(per_rank, default=0)
+        lb = max(
+            -(-sum(areas) // cp_size), max(areas, default=0)
+        ) if areas else 0
+        telemetry.record_event(
+            "dispatch_meta",
+            alg=(
+                chosen_alg.value
+                if isinstance(chosen_alg, DispatchAlgType)
+                else ("preset" if preset_partitions is not None else "trivial")
+            ),
+            total_seqlen_q=total_seqlen_q,
+            total_seqlen_k=total_seqlen_k,
+            chunk_size=chunk_size,
+            num_chunks=num_chunks,
+            cp_size=cp_size,
+            per_rank_area=per_rank,
+            max_area=max_area,
+            lower_bound=lb,
+            balance_ratio=(lb / max_area) if max_area else 1.0,
         )
 
     is_cross = total_seqlen_k != total_seqlen_q

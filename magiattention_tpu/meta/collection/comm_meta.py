@@ -94,6 +94,37 @@ class GroupCollectiveArg:
         payload = self.payload_rows()
         return self.wire_rows() / payload if payload else 1.0
 
+    def padding_rows(self, lowering: str | None = None) -> int:
+        """Alignment-padding waste on the wire: rows transferred that carry
+        no payload (wire - payload) under a lowering."""
+        return max(self.wire_rows(lowering) - self.payload_rows(), 0)
+
+    def telemetry_dict(self, executed: str | None = None) -> dict:
+        """One stage's comm-volume summary for the telemetry registry
+        (rows; multiply by row_bytes for bytes — the runtime does, once
+        tensor dtypes are known). ``executed`` is the lowering the runtime
+        actually runs when it overrides the solver's portable choice."""
+        kind = executed or self.lowering
+        wire = (
+            self.wire_rows(kind)
+            if kind in ("a2a", "ppermute", "ragged")
+            else self.wire_rows(self.lowering)  # e.g. hier: flat # is a bound
+        )
+        payload = self.payload_rows()
+        return {
+            "lowering_planned": self.lowering,
+            "lowering_executed": kind,
+            "payload_rows": payload,
+            "wire_rows": wire,
+            "padding_rows": max(wire - payload, 0),
+            "wire_ratio": wire / payload if payload else 1.0,
+            "a2a_wire_rows": self.wire_rows("a2a"),
+            "a_cap": self.a_cap,
+            "r_max": self.r_max,
+            "send_rows_per_rank": self.send_counts.sum(axis=1).tolist(),
+            "recv_rows_per_rank": self.recv_len.tolist(),
+        }
+
 
 def pick_lowering(arg: GroupCollectiveArg) -> str:
     """Per-stage AUTO wire-tier choice, shared by the static and dynamic

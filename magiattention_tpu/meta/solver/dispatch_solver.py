@@ -29,6 +29,7 @@ from ...common.enum import DispatchAlgType
 from ...common.range import AttnRange
 from ...common.ranges import AttnRanges
 from ...config import DispatchConfig  # canonical definition (config.py)
+from ... import telemetry
 
 
 class BaseDispatchAffinity:
@@ -158,8 +159,11 @@ class DispatchSolver:
             max_area = max(
                 (sum(areas[i] for i in p) for p in parts), default=0
             )
-            return DispatchSolution(
-                partitions=parts, max_area=max_area, lower_bound=lb
+            return self._record(
+                DispatchSolution(
+                    partitions=parts, max_area=max_area, lower_bound=lb
+                ),
+                alg, len(areas), cp_size, areas,
             )
 
         if n % cp_size != 0:
@@ -192,7 +196,38 @@ class DispatchSolver:
 
         parts = [sorted(p) for p in parts]
         max_area = max(sum(areas[i] for i in p) for p in parts)
-        return DispatchSolution(partitions=parts, max_area=max_area, lower_bound=lb)
+        return self._record(
+            DispatchSolution(
+                partitions=parts, max_area=max_area, lower_bound=lb
+            ),
+            alg, n, cp_size, areas,
+        )
+
+    @staticmethod
+    def _record(
+        sol: DispatchSolution,
+        alg: DispatchAlgType,
+        num_chunks: int,
+        cp_size: int,
+        areas: list[int],
+    ) -> DispatchSolution:
+        """Gated telemetry for one solve (AUTO emits one per candidate;
+        the chosen assignment's record is the later ``dispatch_meta`` kind,
+        _make_dispatch_meta.py)."""
+        if telemetry.enabled():
+            telemetry.record_event(
+                "dispatch_solve",
+                alg=alg.value if hasattr(alg, "value") else str(alg),
+                num_chunks=num_chunks,
+                cp_size=cp_size,
+                per_rank_area=[
+                    sum(areas[i] for i in p) for p in sol.partitions
+                ],
+                max_area=sol.max_area,
+                lower_bound=sol.lower_bound,
+                balance_ratio=sol.balance_ratio,
+            )
+        return sol
 
     # -- uneven-shard variants --------------------------------------------
 

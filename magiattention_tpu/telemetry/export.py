@@ -1,0 +1,48 @@
+"""JSONL sink: one line per record, flushed per write, crash-safe.
+
+Records are plain JSON objects; numpy scalars/arrays are converted on the
+way out so call sites can pass solver/planner arrays without ceremony.
+The file opens lazily on the first record, so merely enabling telemetry
+does not create files in processes that never plan or step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, IO
+
+
+def _jsonable(x: Any) -> Any:
+    """Best-effort conversion to JSON-serializable builtins."""
+    import numpy as np
+
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    if isinstance(x, np.generic):
+        return x.item()
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    return str(x)
+
+
+class JsonlSink:
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._f: IO[str] | None = None
+
+    def write(self, record: dict[str, Any]) -> None:
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(_jsonable(record)) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

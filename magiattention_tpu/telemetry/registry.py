@@ -1,0 +1,195 @@
+"""Process-global metrics registry (counters, gauges, timers, events).
+
+One :class:`TelemetryCollector` per process, created lazily on the first
+gated call. Every record flows straight through the JSONL sink
+(telemetry/export.py) — append-only, flushed per record, so a crashed run
+still leaves parseable history — while counters/gauges/last-events stay in
+memory for :func:`summary` / :func:`flat_summary` (the hook
+``benchmarking/perf_report.append_row`` uses to stamp bench rows).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any
+
+from ..env import general as env_general
+from .export import JsonlSink
+
+SCHEMA_VERSION = 1
+
+
+def enabled() -> bool:
+    """The ONE gate every telemetry entry point checks first."""
+    return env_general.is_telemetry_enable()
+
+
+class TelemetryCollector:
+    """Counters + gauges + per-kind last-event cache over a JSONL sink."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
+        self.last_event: dict[str, dict[str, Any]] = {}
+        self._sink = JsonlSink(
+            os.path.join(directory, f"magiattention-{os.getpid()}.jsonl")
+        )
+
+    @property
+    def path(self) -> str:
+        return self._sink.path
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def record_event(self, kind: str, payload: dict[str, Any]) -> None:
+        with self._lock:
+            self._seq += 1
+            record = {
+                "schema_version": SCHEMA_VERSION,
+                "ts": time.time(),
+                "pid": os.getpid(),
+                "seq": self._seq,
+                "kind": kind,
+                **payload,
+            }
+            self.counters[f"events.{kind}"] = (
+                self.counters.get(f"events.{kind}", 0) + 1
+            )
+            self.last_event[kind] = record
+            self._sink.write(record)
+
+    def close(self) -> None:
+        self._sink.close()
+
+
+_collector: TelemetryCollector | None = None
+_collector_lock = threading.Lock()
+
+
+def get_collector() -> TelemetryCollector:
+    """The process-global collector (created on first use; recreated when
+    ``MAGI_ATTENTION_TELEMETRY_DIR`` changes, so tests can redirect it)."""
+    global _collector
+    directory = env_general.telemetry_dir()
+    with _collector_lock:
+        if _collector is None or _collector.directory != directory:
+            if _collector is not None:
+                _collector.close()
+            _collector = TelemetryCollector(directory)
+        return _collector
+
+
+def reset() -> None:
+    """Drop the global collector (tests; a new one is created on demand)."""
+    global _collector
+    with _collector_lock:
+        if _collector is not None:
+            _collector.close()
+        _collector = None
+
+
+# -- module-level gated entry points (what call sites use) -----------------
+
+
+def record_event(kind: str, **payload: Any) -> None:
+    if not enabled():
+        return
+    get_collector().record_event(kind, payload)
+
+
+def inc(name: str, n: int = 1) -> None:
+    if not enabled():
+        return
+    get_collector().inc(name, n)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if not enabled():
+        return
+    get_collector().set_gauge(name, value)
+
+
+@contextmanager
+def stage_timer(name: str, record_kind: str | None = None, **payload: Any):
+    """Gated host wall-timer. Off: identity (no ``perf_counter`` read).
+
+    On: times the block, bumps ``time.<name>.calls`` / ``.total_ms``
+    counters and the ``time.<name>.last_ms`` gauge; with ``record_kind``
+    also emits a JSONL record carrying ``xprof_scope=name`` so the record
+    links to the identically-named ``profile_scope`` span in an xprof trace
+    when MAGI_ATTENTION_PROFILE_MODE is also on.
+    """
+    if not enabled():
+        yield None
+        return
+    c = get_collector()
+    t0 = time.perf_counter()
+    try:
+        yield c
+    finally:
+        ms = (time.perf_counter() - t0) * 1e3
+        c.inc(f"time.{name}.calls")
+        with c._lock:
+            c.counters[f"time.{name}.total_ms"] = int(
+                c.counters.get(f"time.{name}.total_ms", 0) + ms
+            )
+        c.set_gauge(f"time.{name}.last_ms", ms)
+        if record_kind is not None:
+            c.record_event(
+                record_kind, {"xprof_scope": name, "wall_ms": ms, **payload}
+            )
+
+
+def summary() -> dict[str, Any]:
+    """Structured in-memory snapshot: counters, gauges, last event per kind."""
+    if not enabled():
+        return {}
+    c = get_collector()
+    with c._lock:
+        return {
+            "counters": dict(c.counters),
+            "gauges": dict(c.gauges),
+            "last": {k: dict(v) for k, v in c.last_event.items()},
+        }
+
+
+# last-event fields worth carrying onto bench-history rows: comm/balance
+# context for a perf number (kind, field, column suffix)
+_FLAT_FIELDS = (
+    ("dispatch_meta", "balance_ratio", "balance_ratio"),
+    ("dispatch_meta", "alg", "dispatch_alg"),
+    ("attn_step", "overlap_degree", "overlap_degree"),
+    ("attn_step", "wire_bytes_total", "wire_bytes"),
+    ("attn_step", "payload_bytes_total", "payload_bytes"),
+    ("attn_step", "wall_ms", "step_wall_ms"),
+)
+
+
+def flat_summary(prefix: str = "tel_") -> dict[str, Any]:
+    """Flat scalar summary for tabular sinks (bench history CSV rows)."""
+    if not enabled():
+        return {}
+    s = summary()
+    out: dict[str, Any] = {}
+    for kind, field, col in _FLAT_FIELDS:
+        ev = s["last"].get(kind)
+        if ev is not None and field in ev:
+            out[prefix + col] = ev[field]
+    for name in ("runtime_cache.hit", "runtime_cache.miss",
+                 "runtime_cache.evict", "events.attn_step",
+                 "events.plan_build"):
+        if name in s["counters"]:
+            out[prefix + name.replace(".", "_")] = s["counters"][name]
+    return out

@@ -77,18 +77,27 @@ def instrument_host(fn: Callable | None = None, *, name: str | None = None):
 
 @contextmanager
 def add_profile_event(name: str):
-    """Annotate a host-side region in the profiler trace (ref add_nvtx_event)."""
-    with jax.profiler.TraceAnnotation(name):
+    """Annotate a host-side region in the profiler trace (ref
+    add_nvtx_event). Gated on MAGI_ATTENTION_PROFILE_MODE like every other
+    annotation helper here — off means no TraceAnnotation is constructed."""
+    if not env_general.is_profile_mode_enable():
         yield
+    else:
+        with jax.profiler.TraceAnnotation(name):
+            yield
 
 
 class switch_profile:
     """Start/stop a jax profiler window (ref nvtx.py:110 switch_profile).
 
-    Usage::
+    Usable explicitly or as a context manager (exception-safe: the trace
+    window is closed even when the body raises)::
 
         prof = switch_profile(log_dir="/tmp/trace")
         prof.start(); ...steps...; prof.stop()
+
+        with switch_profile(log_dir="/tmp/trace"):
+            ...steps...
     """
 
     def __init__(self, log_dir: str = "/tmp/magiattention_tpu_trace") -> None:
@@ -104,3 +113,10 @@ class switch_profile:
         if self._running:
             jax.profiler.stop_trace()
             self._running = False
+
+    def __enter__(self) -> "switch_profile":
+        self.start()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()

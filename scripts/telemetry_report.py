@@ -1,0 +1,312 @@
+"""Aggregate and print a run summary from telemetry JSONL files.
+
+Reads the ``magiattention-<pid>.jsonl`` files a run produced under
+MAGI_ATTENTION_TELEMETRY_DIR (one record per dispatch solve / plan build /
+attention step, schema in docs/observability.md) and prints a human
+summary: dispatch balance, per-stage comm volumes (payload vs wire vs
+alignment-padding waste), kernel-plan padding efficiency, step timings,
+and runtime-cache behavior.
+
+Usage::
+
+    MAGI_ATTENTION_TELEMETRY=1 MAGI_ATTENTION_TELEMETRY_DIR=/tmp/tel \
+        python my_run.py
+    python scripts/telemetry_report.py /tmp/tel          # a directory
+    python scripts/telemetry_report.py /tmp/tel/*.jsonl  # or files
+    python scripts/telemetry_report.py --json /tmp/tel   # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+SUPPORTED_SCHEMA = 1
+
+
+def load_records(paths: list[str]) -> list[dict]:
+    """Parse records from JSONL files and/or directories of them.
+
+    Skips unparseable lines (a crashed run can truncate its last record)
+    and records from a newer schema than this reader understands.
+    """
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            files.append(p)
+    records: list[dict] = []
+    for fp in files:
+        with open(fp) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("schema_version", 0) > SUPPORTED_SCHEMA:
+                    continue
+                records.append(rec)
+    records.sort(key=lambda r: (r.get("ts", 0), r.get("seq", 0)))
+    return records
+
+
+def _by_kind(records: list[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for r in records:
+        out.setdefault(r.get("kind", "?"), []).append(r)
+    return out
+
+
+def aggregate(records: list[dict]) -> dict:
+    """Cross-record aggregates keyed by section (the printed summary's
+    data; also the --json output)."""
+    kinds = _by_kind(records)
+    agg: dict = {"record_counts": {k: len(v) for k, v in sorted(kinds.items())}}
+
+    metas = kinds.get("dispatch_meta", [])
+    if metas:
+        last = metas[-1]
+        agg["dispatch"] = {
+            "solves": len(metas),
+            "alg": last.get("alg"),
+            "cp_size": last.get("cp_size"),
+            "num_chunks": last.get("num_chunks"),
+            "per_rank_area": last.get("per_rank_area"),
+            "max_area": last.get("max_area"),
+            "lower_bound": last.get("lower_bound"),
+            "balance_ratio": last.get("balance_ratio"),
+        }
+
+    plans = kinds.get("plan_build", [])
+    if plans:
+        last = plans[-1]
+        stages = []
+        for s in last.get("stages", []):
+            stages.append({
+                "stage": s.get("stage", s.get("name")),
+                "lowering": s.get("lowering_executed",
+                                  s.get("lowering_planned")),
+                "payload_rows": s.get("payload_rows"),
+                "wire_rows": s.get("wire_rows"),
+                "padding_rows": s.get("padding_rows"),
+                "wire_ratio": s.get("wire_ratio"),
+            })
+        agg["comm_plan"] = {
+            "builds": len(plans),
+            "planner": last.get("planner"),
+            "stages": stages,
+        }
+
+    steps = kinds.get("attn_step", [])
+    if steps:
+        last = steps[-1]
+        walls = [s["wall_ms"] for s in steps if s.get("wall_ms") is not None]
+        agg["attn_step"] = {
+            "steps": len(steps),
+            "backend": last.get("backend"),
+            "overlap_degree": last.get("overlap_degree"),
+            "block_q": last.get("block_q"),
+            "block_k": last.get("block_k"),
+            "payload_bytes_total": last.get("payload_bytes_total"),
+            "wire_bytes_total": last.get("wire_bytes_total"),
+            "padding_bytes_total": last.get("padding_bytes_total"),
+            "band_elems": last.get("band_elems"),
+            "padded_elems": last.get("padded_elems"),
+            "est_flops_fwd": last.get("est_flops_fwd"),
+            "padded_flops_fwd": last.get("padded_flops_fwd"),
+            "stages": last.get("stages"),
+            "wall_ms_last": walls[-1] if walls else None,
+            "wall_ms_min": min(walls) if walls else None,
+        }
+
+    ffa = kinds.get("ffa_plan", [])
+    if ffa:
+        padded = sum(r.get("padded_elems", 0) for r in ffa)
+        band = sum(r.get("band_elems", 0) for r in ffa)
+        agg["ffa_plans"] = {
+            "plans": len(ffa),
+            "padded_elems": padded,
+            "band_elems": band,
+            "padding_ratio": padded / band if band else None,
+        }
+
+    tiles = kinds.get("tile_policy", [])
+    if tiles:
+        last = tiles[-1]
+        agg["tile_policy"] = {
+            "picks": len(tiles),
+            "mode": last.get("mode"),
+            "fwd_blocks": last.get("fwd_blocks"),
+            "dq_blocks": last.get("dq_blocks"),
+            "dkv_blocks": last.get("dkv_blocks"),
+            "candidates_scored": last.get("candidates_scored"),
+        }
+
+    caches = kinds.get("runtime_cache", [])
+    if caches:
+        agg["runtime_cache"] = {
+            k: caches[-1].get(k)
+            for k in ("hits", "misses", "evictions", "size", "maxsize")
+        }
+
+    hier = kinds.get("hier_plan", [])
+    if hier:
+        last = hier[-1]
+        agg["hier_comm"] = {
+            "plans": len(hier),
+            "dcn_rows": last.get("dcn_rows"),
+            "flat_dcn_rows": last.get("flat_dcn_rows"),
+            "dcn_dedup_ratio": last.get("dcn_dedup_ratio"),
+        }
+    return agg
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n}"
+
+
+def format_summary(agg: dict) -> str:
+    lines = ["# magiattention telemetry summary"]
+    counts = agg.get("record_counts", {})
+    lines.append(
+        "records: "
+        + (", ".join(f"{k}={v}" for k, v in counts.items()) or "none")
+    )
+
+    d = agg.get("dispatch")
+    if d:
+        lines.append("")
+        lines.append(
+            f"dispatch [{d['alg']}] cp={d['cp_size']} "
+            f"chunks={d['num_chunks']} solves={d['solves']}"
+        )
+        lines.append(
+            f"  balance_ratio={d['balance_ratio']:.4f} "
+            f"(max_area={d['max_area']} lower_bound={d['lower_bound']})"
+        )
+        lines.append(f"  per_rank_area={d['per_rank_area']}")
+
+    cp = agg.get("comm_plan")
+    if cp:
+        lines.append("")
+        lines.append(
+            f"comm plan [{cp.get('planner') or 'static'}] "
+            f"builds={cp['builds']}"
+        )
+        for s in cp["stages"]:
+            ratio = s.get("wire_ratio")
+            ratio_s = f", wire_ratio={ratio:.3f}" if ratio is not None else ""
+            lines.append(
+                f"  stage {s['stage']}: {s['lowering']} "
+                f"payload={s['payload_rows']} wire={s['wire_rows']} rows "
+                f"(padding={s['padding_rows']}{ratio_s})"
+            )
+
+    st = agg.get("attn_step")
+    if st:
+        lines.append("")
+        lines.append(
+            f"attn steps={st['steps']} backend={st['backend']} "
+            f"overlap_degree={st['overlap_degree']} "
+            f"blocks=({st['block_q']}, {st['block_k']})"
+        )
+        lines.append(
+            f"  comm: payload={_fmt_bytes(st['payload_bytes_total'])} "
+            f"wire={_fmt_bytes(st['wire_bytes_total'])} "
+            f"padding_waste={_fmt_bytes(st['padding_bytes_total'])}"
+        )
+        if st.get("band_elems") is not None:
+            padded, band = st["padded_elems"], st["band_elems"]
+            eff = band / padded if padded else 1.0
+            lines.append(
+                f"  kernel work: band_elems={band} padded_elems={padded} "
+                f"(grid efficiency {eff:.1%}); "
+                f"est_flops_fwd={st['est_flops_fwd']:.3g} "
+                f"executed={st['padded_flops_fwd']:.3g}"
+            )
+        if st.get("wall_ms_last") is not None:
+            lines.append(
+                f"  wall: last={st['wall_ms_last']:.1f} ms "
+                f"min={st['wall_ms_min']:.1f} ms "
+                "(first call includes trace+compile; per-stage device time "
+                "lives in the xprof spans named by each record's "
+                "xprof_scope)"
+            )
+
+    fp = agg.get("ffa_plans")
+    if fp:
+        lines.append("")
+        ratio = fp["padding_ratio"]
+        lines.append(
+            f"ffa plans={fp['plans']} band_elems={fp['band_elems']} "
+            f"padded_elems={fp['padded_elems']}"
+            + (f" (padding_ratio={ratio:.3f})" if ratio else "")
+        )
+
+    tp = agg.get("tile_policy")
+    if tp:
+        lines.append("")
+        lines.append(
+            f"tile policy [{tp['mode']}] picks={tp['picks']} "
+            f"fwd={tp['fwd_blocks']} dq={tp['dq_blocks'] or 'inherit'} "
+            f"dkv={tp['dkv_blocks'] or 'inherit'} "
+            f"(scored {tp['candidates_scored']} candidates)"
+        )
+
+    rc = agg.get("runtime_cache")
+    if rc:
+        lines.append("")
+        lines.append(
+            f"runtime cache: hits={rc['hits']} misses={rc['misses']} "
+            f"evictions={rc['evictions']} size={rc['size']}/{rc['maxsize']}"
+        )
+
+    hc = agg.get("hier_comm")
+    if hc:
+        lines.append("")
+        lines.append(
+            f"hier comm plans={hc['plans']}: dcn_rows={hc['dcn_rows']} "
+            f"vs flat {hc['flat_dcn_rows']} "
+            f"(dedup x{hc['dcn_dedup_ratio']:.2f})"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "paths", nargs="+",
+        help="telemetry JSONL files or directories containing them",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print the aggregate as JSON instead of the text summary",
+    )
+    args = ap.parse_args(argv)
+    records = load_records(args.paths)
+    if not records:
+        print("no telemetry records found", file=sys.stderr)
+        return 1
+    agg = aggregate(records)
+    if args.json:
+        print(json.dumps(agg, indent=2))
+    else:
+        print(format_summary(agg))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

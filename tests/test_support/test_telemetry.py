@@ -1,0 +1,192 @@
+"""Telemetry layer: JSONL records for one CPU dispatch+attention step, the
+report CLI round trip, the zero-overhead-when-off contract, and the runtime
+cache counters (docs/observability.md)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.telemetry import registry
+
+from tests.test_support.script_loading import load_script
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPORT = os.path.join(REPO, "scripts", "telemetry_report.py")
+
+# distinctive shape so the module-global runtime dict can't already hold
+# this key from another test (a cache hit would skip the plan records)
+S, H, HK, D, CHUNK = 192, 2, 1, 32, 24
+
+
+@pytest.fixture(autouse=True)
+def _fresh_collector():
+    telemetry.reset()
+    yield
+    telemetry.reset()  # close any JSONL handle into tmp_path
+
+
+def _run_step(mask_types=(1,), chunk=CHUNK, overlap_degree=2):
+    from magiattention_tpu import DistAttnConfig, OverlapConfig
+    from magiattention_tpu.api import (
+        calc_attn, dispatch, magi_attn_flex_key, undispatch,
+    )
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), axis_names=("cp",))
+    key = magi_attn_flex_key(
+        [[0, S]], [[0, S]], list(mask_types), S, S,
+        mesh=mesh, cp_axis="cp", chunk_size=chunk,
+        dist_attn_config=DistAttnConfig(
+            overlap_config=OverlapConfig(degree=overlap_degree)
+        ),
+    )
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, HK, D)), jnp.float32)
+    q_d = dispatch(q, key)
+    k_d = dispatch(k, key, role="kv")
+    v_d = dispatch(v, key, role="kv")
+    out_d, _ = calc_attn(q_d, k_d, v_d, key)
+    return jax.block_until_ready(undispatch(out_d, key))
+
+
+def _load_jsonl(tmp_path):
+    files = sorted(tmp_path.glob("*.jsonl"))
+    assert files, "telemetry run produced no JSONL file"
+    records = []
+    for fp in files:
+        with open(fp) as f:
+            records.extend(json.loads(line) for line in f if line.strip())
+    return records
+
+
+def test_step_emits_schema_records(tmp_path, monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    _run_step()
+
+    records = _load_jsonl(tmp_path)
+    kinds = {r["kind"] for r in records}
+    assert {"dispatch_meta", "plan_build", "ffa_plan", "attn_step",
+            "runtime_cache"} <= kinds
+    assert all(r["schema_version"] == telemetry.SCHEMA_VERSION
+               for r in records)
+
+    # dispatch: per-rank attention area + balance ratio
+    meta = [r for r in records if r["kind"] == "dispatch_meta"][-1]
+    assert len(meta["per_rank_area"]) == 4
+    assert meta["max_area"] == max(meta["per_rank_area"])
+    assert 0.0 < meta["balance_ratio"] <= 1.0
+
+    # comm plan: per-stage payload vs wire rows incl alignment padding
+    plan = [r for r in records if r["kind"] == "plan_build"][-1]
+    assert plan["planner"] == "static"
+    for s in plan["stages"]:
+        assert s["wire_rows"] >= s["payload_rows"]
+        assert s["padding_rows"] == s["wire_rows"] - s["payload_rows"]
+        assert s["lowering_executed"] in ("a2a", "ppermute", "ragged", "hier")
+
+    # attention step: overlap degree, host timing, blocks, byte volumes
+    step = [r for r in records if r["kind"] == "attn_step"][-1]
+    assert step["overlap_degree"] == len(step["stages"]) >= 1
+    assert step["wall_ms"] > 0
+    assert step["block_q"] > 0 and step["block_k"] > 0
+    assert step["wire_bytes_total"] >= step["payload_bytes_total"] > 0
+    assert (step["padding_bytes_total"]
+            == step["wire_bytes_total"] - step["payload_bytes_total"])
+    for s in step["stages"]:
+        assert s["wire_bytes"] == s["wire_rows"] * step["row_bytes"]
+        assert s["xprof_scope"].startswith("group_cast_stage")
+    # estimated (band) vs executed (padded-grid) work
+    assert step["padded_elems"] >= step["band_elems"] > 0
+    assert step["padded_flops_fwd"] >= step["est_flops_fwd"] > 0
+
+    # runtime cache counters rode along
+    cache = [r for r in records if r["kind"] == "runtime_cache"][-1]
+    assert cache["misses"] >= 1 and cache["size"] >= 1
+
+    # in-memory summary agrees with the stream
+    flat = telemetry.flat_summary()
+    assert flat["tel_balance_ratio"] == meta["balance_ratio"]
+    assert flat["tel_events_attn_step"] >= 1
+
+
+def test_report_cli_round_trip(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    # distinct chunking: the module-global runtime dict caches the other
+    # test's key, and a cache hit would skip the plan-build records
+    _run_step(chunk=48)
+    telemetry.reset()  # flush/close before the reader opens the file
+
+    mod = load_script(REPORT, "telemetry_report")
+    records = mod.load_records([str(tmp_path)])
+    assert records and records == sorted(
+        records, key=lambda r: (r["ts"], r["seq"])
+    )
+    agg = mod.aggregate(records)
+    assert 0.0 < agg["dispatch"]["balance_ratio"] <= 1.0
+    assert agg["attn_step"]["steps"] >= 1
+    assert agg["runtime_cache"]["misses"] >= 1
+    text = mod.format_summary(agg)
+    for token in ("balance_ratio", "attn steps", "runtime cache", "stage 0"):
+        assert token in text
+
+    assert mod.main([str(tmp_path)]) == 0
+    assert "telemetry summary" in capsys.readouterr().out
+
+
+class _NoClock:
+    """time stand-in that fails the test on ANY clock read."""
+
+    @staticmethod
+    def perf_counter():  # pragma: no cover - reaching here IS the failure
+        raise AssertionError("timer read on the hot path with telemetry off")
+
+    @staticmethod
+    def time():  # pragma: no cover
+        raise AssertionError("clock read on the hot path with telemetry off")
+
+
+def test_off_means_no_io_and_no_timers(tmp_path, monkeypatch):
+    monkeypatch.delenv("MAGI_ATTENTION_TELEMETRY", raising=False)
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    # replace the registry module's clock binding (not the global time
+    # module): any gated path that reads a timer now raises
+    monkeypatch.setattr(registry, "time", _NoClock)
+
+    # distinct chunking -> guaranteed runtime-dict miss, so the full
+    # plan-build + step path runs under the poisoned clock
+    _run_step(chunk=16, overlap_degree=1)
+
+    with telemetry.stage_timer("x"):
+        pass
+    telemetry.inc("noop")
+    telemetry.record_event("noop")
+    assert registry._collector is None, "collector created with flag off"
+    assert list(tmp_path.glob("*.jsonl")) == []
+    assert telemetry.summary() == {}
+    assert telemetry.flat_summary() == {}
+
+
+def test_runtime_dict_stats(monkeypatch):
+    import magiattention_tpu.dist_attn_runtime_mgr as mgr_mod
+
+    monkeypatch.setattr(
+        mgr_mod, "DistAttnRuntimeMgr", lambda key, mesh: object()
+    )
+    d = mgr_mod.DistAttnRuntimeDict(maxsize=2)
+    for name in ("a", "b", "c"):  # 3 misses, 1 eviction (maxsize 2)
+        d.get_or_create(name, None)
+    d.get_or_create("c", None)  # hit
+    d.get_or_create("a", None)  # evicted above -> miss again, evicts "b"
+    assert d.get_stats() == {
+        "hits": 1, "misses": 4, "evictions": 2, "size": 2, "maxsize": 2,
+    }
+    assert d.get("b") is None and d.get("c") is not None
