@@ -4,13 +4,21 @@
 PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
-	telemetry-smoke
+	telemetry-smoke analysis lint verify-plans
 
-test:  ## fast tier: the correctness surface in < 5 min on one core
+test: analysis  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
-test-all:  ## everything: + model training, scale oracles, property suites
+test-all: analysis  ## everything: + model training, scale oracles, property suites
 	$(PY) -m pytest tests/ -q
+
+analysis: lint verify-plans  ## static passes: AST repo linter + plan verifier
+
+lint:  ## AST repo rules (analysis/lint.py) over the package, with baseline
+	$(PY) -m magiattention_tpu.analysis.lint
+
+verify-plans:  ## R1-R5 plan verifier over the golden solver corpus (CPU)
+	JAX_PLATFORMS=cpu $(PY) scripts/verify_plans.py
 
 test-slow:  ## only the slow tier (training / 262k-131k oracles / property)
 	$(PY) -m pytest tests/ -q -m slow

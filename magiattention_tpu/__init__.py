@@ -8,7 +8,8 @@ over ICI, and a multi-stage compute/comm-overlap CP runtime.
 """
 
 import logging as _logging
-import os as _os
+
+from .env.general import log_level as _log_level
 
 __version__ = "0.1.0"
 
@@ -19,7 +20,7 @@ if not _logger.handlers:
         _logging.Formatter("[%(asctime)s][%(name)s][%(levelname)s] %(message)s")
     )
     _logger.addHandler(_handler)
-_logger.setLevel(_os.environ.get("MAGI_ATTENTION_LOG_LEVEL", "WARNING").upper())
+_logger.setLevel(_log_level())
 
 from . import common, config, env  # noqa: F401, E402
 from .config import (  # noqa: F401, E402

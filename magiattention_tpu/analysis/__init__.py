@@ -1,0 +1,31 @@
+"""Static analysis layer: plan verifier + repo-rule linter.
+
+Two complementary passes turn the codebase's implicit contracts into
+machine-checked ones (see docs/plan_invariants.md):
+
+- :mod:`verifier` proves (or reports violations of) the named rule set
+  R1-R5 over already-constructed plan metadata — slices, ``DispatchMeta``,
+  ``CommMeta``/``GroupCollectiveArg``, ``CalcMeta``, ``DynamicAttnPlan``
+  and tile choices — before any collective runs.
+- :mod:`lint` is an AST-based linter enforcing codebase rules (no raw
+  ``os.environ`` outside ``env/``, no host clocks in kernels/functional,
+  no ``print`` in library code, every public ``meta/collection`` dataclass
+  covered by a verifier rule).
+
+Entry points: ``make analysis``, ``scripts/verify_plans.py`` (golden
+corpus), and the opt-in runtime hook ``MAGI_ATTENTION_VERIFY_PLANS=1``
+(``dist_attn_runtime_mgr`` -> :func:`maybe_verify_runtime`).
+"""
+
+from .violation import (  # noqa: F401
+    PlanVerificationError,
+    RULES,
+    RULE_COVERAGE,
+    VerifyReport,
+    Violation,
+)
+from .verifier import (  # noqa: F401
+    maybe_verify_runtime,
+    verify_dynamic_plan,
+    verify_plan,
+)

@@ -17,6 +17,7 @@ from jax.sharding import Mesh
 
 from ..common.enum import AttnMaskType
 from ..common.forward_meta import AttnForwardMeta
+from ..common.range import RangeError
 from ..common.ranges import AttnRanges
 from ..config import DistAttnConfig
 from ..dist_attn_runtime_mgr import (
@@ -110,13 +111,15 @@ def _validate_mask_inputs(
             f"attn_mask_type ({len(mask_ints)}) must have the same length"
         )
     if q_ranges.end > total_seqlen_q:
-        raise ValueError(
-            f"q_ranges reach {q_ranges.end} > total_seqlen_q "
+        bad = max(q_ranges, key=lambda r: r.end)
+        raise RangeError(
+            f"q range {bad} reaches {q_ranges.end} > total_seqlen_q "
             f"{total_seqlen_q}"
         )
     if k_ranges.end > total_seqlen_k:
-        raise ValueError(
-            f"k_ranges reach {k_ranges.end} > total_seqlen_k "
+        bad = max(k_ranges, key=lambda r: r.end)
+        raise RangeError(
+            f"k range {bad} reaches {k_ranges.end} > total_seqlen_k "
             f"{total_seqlen_k}"
         )
 

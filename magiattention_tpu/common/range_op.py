@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..functional.utils import correct_attn_out_lse
+from .range import RangeError
 
 
 def _ranges_to_indices(ranges) -> np.ndarray:
@@ -71,8 +72,9 @@ def range_reduce(
     oi = _ranges_to_indices(out_ranges)
     ii = _ranges_to_indices(inp_ranges)
     if len(oi) != len(ii):
-        raise ValueError(
-            f"range length mismatch: out {len(oi)} vs inp {len(ii)} rows"
+        raise RangeError(
+            f"range length mismatch: out_ranges {out_ranges} cover "
+            f"{len(oi)} rows vs inp_ranges {inp_ranges} {len(ii)} rows"
         )
     if len(oi) == 0:
         return out

@@ -134,6 +134,7 @@ class DistAttnRuntimeMgr:
                         )
                     ],
                 )
+            self._maybe_verify()
             return
 
         self.dynamic_plan = None
@@ -153,6 +154,17 @@ class DistAttnRuntimeMgr:
             use_overlap=None if overlap_cfg.enable else False,
         )
         self._record_comm_plan()
+        self._maybe_verify()
+
+    def _maybe_verify(self) -> None:
+        """Opt-in static verification of the freshly built plan
+        (MAGI_ATTENTION_VERIFY_PLANS=1, analysis/verifier.py): raises
+        PlanVerificationError on error-severity violations so a malformed
+        plan fails at build time instead of as a wrong loss inside
+        shard_map."""
+        from .analysis import maybe_verify_runtime
+
+        maybe_verify_runtime(self)
 
     def _stage_telemetry_dicts(self) -> list[dict]:
         """Per-stage comm summaries with the EXECUTED lowering: the runtime

@@ -31,6 +31,13 @@ def is_sanity_check_enable() -> bool:
     return _get_bool("MAGI_ATTENTION_SANITY_CHECK")
 
 
+def is_verify_plans_enable() -> bool:
+    """Run the static plan verifier (analysis/verifier.py R1-R5) at
+    plan-build time and raise PlanVerificationError on error-severity
+    violations. Plan-time only — never on the step hot path."""
+    return _get_bool("MAGI_ATTENTION_VERIFY_PLANS")
+
+
 def kernel_backend() -> str:
     """Attention kernel backend: ffa | sdpa | sdpa_online."""
     return _get_str("MAGI_ATTENTION_KERNEL_BACKEND", "ffa").lower()

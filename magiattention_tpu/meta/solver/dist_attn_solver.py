@@ -199,8 +199,17 @@ class DistAttnSolver:
                 or (ii < 0).any()
                 or (dm[:, 3] > iv_ends[ii]).any()
             ):
-                raise ValueError(
-                    "deferred remote piece outside merged intervals"
+                bad = (
+                    0
+                    if len(iv_starts) == 0
+                    else int(
+                        np.argmax((ii < 0) | (dm[:, 3] > iv_ends[ii]))
+                    )
+                )
+                raise RangeError(
+                    f"deferred remote piece k range [{int(dm[bad, 2])}, "
+                    f"{int(dm[bad, 3])}) outside the merged receive "
+                    "intervals"
                 )
             areas = band_area_batch(
                 dm[:, 0] + dm[:, 6], dm[:, 1] + dm[:, 6],

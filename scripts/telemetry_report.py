@@ -156,6 +156,25 @@ def aggregate(records: list[dict]) -> dict:
             for k in ("hits", "misses", "evictions", "size", "maxsize")
         }
 
+    verifies = kinds.get("plan_verify", [])
+    if verifies:
+        last = verifies[-1]
+        walls = [
+            v["wall_ms"] for v in verifies if v.get("wall_ms") is not None
+        ]
+        agg["plan_verify"] = {
+            "runs": len(verifies),
+            "planner": last.get("planner"),
+            "rules_run": last.get("rules_run"),
+            "errors_total": sum(v.get("errors", 0) for v in verifies),
+            "warnings_total": sum(v.get("warnings", 0) for v in verifies),
+            "fired_rules": sorted(
+                {r for v in verifies for r in v.get("fired_rules", [])}
+            ),
+            "wall_ms_last": walls[-1] if walls else None,
+            "wall_ms_total": sum(walls) if walls else None,
+        }
+
     hier = kinds.get("hier_plan", [])
     if hier:
         last = hier[-1]
@@ -272,6 +291,23 @@ def format_summary(agg: dict) -> str:
         lines.append(
             f"runtime cache: hits={rc['hits']} misses={rc['misses']} "
             f"evictions={rc['evictions']} size={rc['size']}/{rc['maxsize']}"
+        )
+
+    pv = agg.get("plan_verify")
+    if pv:
+        lines.append("")
+        fired = ",".join(pv["fired_rules"]) or "none"
+        wall = (
+            f" wall_last={pv['wall_ms_last']:.1f} ms "
+            f"total={pv['wall_ms_total']:.1f} ms"
+            if pv.get("wall_ms_last") is not None
+            else ""
+        )
+        lines.append(
+            f"plan verify [{pv.get('planner') or '?'}] runs={pv['runs']} "
+            f"rules={','.join(pv.get('rules_run') or [])} "
+            f"errors={pv['errors_total']} warnings={pv['warnings_total']} "
+            f"fired={fired}{wall}"
         )
 
     hc = agg.get("hier_comm")

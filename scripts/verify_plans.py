@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Run the static plan verifier (analysis/) over a golden corpus of solver
+outputs — masks x cp_sizes x overlap degrees, static AND dynamic planners —
+entirely on CPU. Exits non-zero on any error-severity violation; this is
+the second half of ``make analysis`` (the first is the AST linter).
+
+The corpus mirrors tests/test_solver/golden_plan_lib.py's canonical masks
+(the regression proof for ISSUE satellite 1: the shipped solvers produce
+R1-R5-clean plans across the whole grid).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from magiattention_tpu.analysis import verify_dynamic_plan, verify_plan  # noqa: E402
+from magiattention_tpu.analysis.verifier import check_tiles
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.config import DistAttnConfig, OverlapConfig
+from magiattention_tpu.meta import (
+    make_attn_meta_from_dispatch_meta,
+    make_dispatch_meta_from_qk_ranges,
+)
+
+SEQ = 2048
+CHUNK = 128
+
+
+def canonical_masks() -> dict[str, tuple]:
+    """name -> (q_ranges, k_ranges, mask_types); same grid as the golden
+    solver tests (tests/test_solver/golden_plan_lib.py)."""
+    s = SEQ
+    h = s // 2
+    M = AttnMaskType
+    return {
+        "full": ([[0, s]], [[0, s]], [M.FULL]),
+        "causal": ([[0, s]], [[0, s]], [M.CAUSAL]),
+        "varlen_block_causal": (
+            [[0, h], [h, s]], [[0, h], [h, s]], [M.CAUSAL, M.CAUSAL],
+        ),
+        "inv_causal": ([[0, s]], [[0, s]], [M.INVCAUSAL]),
+        "shared_prefix": (
+            [[0, s], [256, s]], [[0, 256], [256, s]], [M.FULL, M.CAUSAL],
+        ),
+        "block_sparse": (
+            [[0, 512], [512, 1024], [1024, 1536], [1536, 2048], [0, s]],
+            [[0, 512], [0, 1024], [512, 1536], [1024, 2048], [0, 256]],
+            [M.CAUSAL, M.FULL, M.FULL, M.CAUSAL, M.FULL],
+        ),
+        "sliding_window": (
+            [[0, s], [0, s]], [[0, s], [0, s]],
+            [M.BICAUSAL, M.FULL],
+        ),
+    }
+
+
+def _verify_static(name: str, cp: int, degree: int, verbose: bool) -> int:
+    qr_l, kr_l, tm = canonical_masks()[name]
+    qr = AttnRanges.from_ranges(qr_l)
+    kr = AttnRanges.from_ranges(kr_l)
+    cfg = DistAttnConfig(overlap_config=OverlapConfig(degree=degree))
+    mq, mkv, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, list(tm), SEQ, SEQ, CHUNK, cp, cfg.dispatch_config
+    )
+    cmm, calc = make_attn_meta_from_dispatch_meta(
+        bucket, mq, cfg, dispatch_meta_kv=mkv
+    )
+    report = verify_plan(
+        dispatch_meta=mq,
+        bucket=bucket,
+        comm_meta=cmm,
+        calc_meta=calc,
+        global_slices=(qr, kr, list(tm), SEQ, SEQ),
+        split_alignment=cfg.grpcoll_config.split_alignment,
+    )
+    # R5 over the blocks the FFA entry would resolve for this geometry
+    from magiattention_tpu.kernels.ffa import (
+        default_blocks,
+        resolve_bwd_overrides,
+    )
+
+    sq = calc.shard_len
+    sk = (calc.kv_shard_len or 0) + sum(calc.recv_len_per_stage)
+    bq, bk = default_blocks(sq, sk)
+    sqp = -(-max(sq, 1) // bq) * bq
+    skp = -(-max(sk, 1) // bk) * bk
+    dq, dkv = resolve_bwd_overrides(bq, bk, sqp, skp)
+    check_tiles(report, (bq, bk), sq, sk, dq_blocks=dq, dkv_blocks=dkv)
+    return _report(f"{name}/cp{cp}/ov{degree}", report, verbose)
+
+
+def _verify_dynamic(name: str, cp: int, verbose: bool) -> int:
+    from magiattention_tpu.meta._make_attn_meta import make_dynamic_attn_plan
+
+    qr_l, kr_l, tm = canonical_masks()[name]
+    qr = AttnRanges.from_ranges(qr_l)
+    kr = AttnRanges.from_ranges(kr_l)
+    cfg = DistAttnConfig()
+    mq, mkv, _bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, list(tm), SEQ, SEQ, CHUNK, cp, cfg.dispatch_config
+    )
+    plan = make_dynamic_attn_plan(
+        qr, kr, list(tm), mq, cfg, dispatch_meta_kv=mkv
+    )
+    report = verify_dynamic_plan(
+        plan, split_alignment=cfg.grpcoll_config.split_alignment
+    )
+    return _report(f"{name}/cp{cp}/dynamic", report, verbose)
+
+
+def _report(label: str, report, verbose: bool) -> int:
+    errs, warns = report.errors(), report.warnings()
+    status = "FAIL" if errs else "ok"
+    line = (
+        f"[{status}] {label}: rules={','.join(report.rules_run)} "
+        f"errors={len(errs)} warnings={len(warns)}\n"
+    )
+    sys.stdout.write(line)
+    shown = errs + (warns if verbose else [])
+    for v in shown:
+        sys.stdout.write(f"    {v}\n")
+    return len(errs)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--cp-sizes", default="1,2,4,8",
+        help="comma-separated cp sizes (default 1,2,4,8)",
+    )
+    ap.add_argument(
+        "--overlap-degrees", default="1,2,4",
+        help="comma-separated static overlap degrees (default 1,2,4)",
+    )
+    ap.add_argument(
+        "--masks", default=None,
+        help="comma-separated mask names (default: all canonical masks)",
+    )
+    ap.add_argument("--skip-dynamic", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print warnings")
+    args = ap.parse_args(argv)
+
+    masks = (
+        args.masks.split(",") if args.masks else list(canonical_masks())
+    )
+    cps = [int(x) for x in args.cp_sizes.split(",")]
+    degrees = [int(x) for x in args.overlap_degrees.split(",")]
+
+    total_errors = 0
+    n_plans = 0
+    for name in masks:
+        for cp in cps:
+            for degree in degrees:
+                total_errors += _verify_static(
+                    name, cp, degree, args.verbose
+                )
+                n_plans += 1
+            if not args.skip_dynamic and cp > 1:
+                total_errors += _verify_dynamic(name, cp, args.verbose)
+                n_plans += 1
+    sys.stdout.write(
+        f"verified {n_plans} plan(s): "
+        f"{'FAIL' if total_errors else 'all clean'} "
+        f"({total_errors} error-severity violation(s))\n"
+    )
+    return 1 if total_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""MAGI_ATTENTION_VERIFY_PLANS runtime hook + plan_verify telemetry
+(ISSUE 3 satellite 6): verification runs at plan-build time in
+DistAttnRuntimeMgr, records through the registry, and raises on
+error-severity violations."""
+
+import glob
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from magiattention_tpu.api import init_dist_attn_runtime_mgr
+from magiattention_tpu.env import general as env_general
+
+S, CHUNK = 256, 16
+
+
+def _mesh(cp=4):
+    return jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:cp]), axis_names=("cp",)
+    )
+
+
+def _build_mgr():
+    return init_dist_attn_runtime_mgr(
+        [[0, S]], [[0, S]], ["causal"], S, S, CHUNK, mesh=_mesh()
+    )
+
+
+def test_env_getter_default_off(monkeypatch):
+    monkeypatch.delenv("MAGI_ATTENTION_VERIFY_PLANS", raising=False)
+    assert env_general.is_verify_plans_enable() is False
+    monkeypatch.setenv("MAGI_ATTENTION_VERIFY_PLANS", "1")
+    assert env_general.is_verify_plans_enable() is True
+
+
+def test_hook_noop_when_disabled(monkeypatch):
+    from magiattention_tpu.analysis import maybe_verify_runtime
+
+    monkeypatch.delenv("MAGI_ATTENTION_VERIFY_PLANS", raising=False)
+    mgr = _build_mgr()
+    assert maybe_verify_runtime(mgr) is None
+
+
+def test_mgr_builds_clean_under_hook(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_VERIFY_PLANS", "1")
+    mgr = _build_mgr()  # a valid plan must not raise
+    from magiattention_tpu.analysis import maybe_verify_runtime
+
+    report = maybe_verify_runtime(mgr)
+    assert report is not None and report.ok()
+    assert {"R1", "R2", "R3", "R4", "R5"} <= set(report.rules_run)
+
+
+def test_hook_raises_on_corrupted_plan(monkeypatch):
+    from magiattention_tpu.analysis import (
+        PlanVerificationError,
+        maybe_verify_runtime,
+    )
+
+    monkeypatch.setenv("MAGI_ATTENTION_VERIFY_PLANS", "1")
+    mgr = _build_mgr()
+    arg = next(a for a in mgr.calc_meta.host_args if a.num_slices)
+    arg.q_ranges[0, 0] = -5
+    with pytest.raises(PlanVerificationError, match="R1"):
+        maybe_verify_runtime(mgr)
+    arg.q_ranges[0, 0] = 0  # un-corrupt the shared cached plan
+
+
+def test_plan_verify_telemetry_record(monkeypatch, tmp_path):
+    import magiattention_tpu.telemetry as telemetry
+
+    monkeypatch.setenv("MAGI_ATTENTION_VERIFY_PLANS", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        from magiattention_tpu.analysis import maybe_verify_runtime
+
+        mgr = _build_mgr()
+        maybe_verify_runtime(mgr)
+    finally:
+        telemetry.reset()  # close the JSONL handle before reading back
+    records = []
+    for path in glob.glob(str(tmp_path / "*.jsonl")):
+        with open(path) as f:
+            records += [json.loads(ln) for ln in f if ln.strip()]
+    pv = [r for r in records if r.get("kind") == "plan_verify"]
+    assert pv, f"no plan_verify record in {records}"
+    last = pv[-1]
+    assert last["errors"] == 0
+    assert last["planner"] == "static"
+    assert set(last["rules_run"]) >= {"R1", "R2", "R3", "R4"}
+    assert last["wall_ms"] >= 0
+
+    # and the report CLI surfaces it
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo_root, "scripts",
+                                      "telemetry_report.py"), str(tmp_path)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "plan verify" in out

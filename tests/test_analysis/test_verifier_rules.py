@@ -1,0 +1,298 @@
+"""Mutation tests for the plan verifier (ISSUE 3 satellite 3).
+
+Take a VALID solver plan, corrupt one field at a time, and assert exactly
+the expected rule_id fires and nothing else — proving each rule both
+catches its failure mode and stays quiet otherwise. The clean-plan case
+doubles as the regression proof that the shipped solvers satisfy R1-R5
+(satellite 1; the full masks x cp x overlap grid runs in
+scripts/verify_plans.py under ``make analysis``).
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.analysis import (
+    PlanVerificationError,
+    verify_dynamic_plan,
+    verify_plan,
+)
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.range import AttnRange
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.config import DistAttnConfig, OverlapConfig
+from magiattention_tpu.meta import (
+    make_attn_meta_from_dispatch_meta,
+    make_dispatch_meta_from_qk_ranges,
+)
+from magiattention_tpu.meta._make_attn_meta import make_dynamic_attn_plan
+
+SEQ, CHUNK, CP = 512, 64, 4
+
+
+@pytest.fixture(scope="module")
+def plan():
+    qr = AttnRanges.from_ranges([[0, SEQ]])
+    kr = AttnRanges.from_ranges([[0, SEQ]])
+    mt = [AttnMaskType.CAUSAL]
+    cfg = DistAttnConfig(overlap_config=OverlapConfig(degree=2))
+    mq, mkv, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, mt, SEQ, SEQ, CHUNK, CP, cfg.dispatch_config
+    )
+    cm, calc = make_attn_meta_from_dispatch_meta(
+        bucket, mq, cfg, dispatch_meta_kv=mkv
+    )
+    return {
+        "qr": qr, "kr": kr, "mt": mt, "cfg": cfg,
+        "mq": mq, "mkv": mkv, "bucket": bucket, "cm": cm, "calc": calc,
+        "align": cfg.grpcoll_config.split_alignment,
+    }
+
+
+def _full_verify(p, **overrides):
+    kw = dict(
+        dispatch_meta=p["mq"], bucket=p["bucket"],
+        comm_meta=p["cm"], calc_meta=p["calc"],
+        global_slices=(p["qr"], p["kr"], p["mt"], SEQ, SEQ),
+        split_alignment=p["align"],
+    )
+    kw.update(overrides)
+    return verify_plan(**kw)
+
+
+# ---------------------------------------------------------------------------
+# clean plan: every rule runs, nothing fires
+# ---------------------------------------------------------------------------
+
+
+def test_clean_plan_is_violation_free(plan):
+    report = _full_verify(plan)
+    assert report.ok()
+    assert report.fired_rules() == set()
+    assert set(report.rules_run) == {"R1", "R2", "R3", "R4"}
+
+
+def test_clean_plan_multi_stage(plan):
+    # the degree-2 config actually produced multiple stages, so the R3/R4
+    # mutations below exercise real multi-stage structure
+    assert plan["cm"].overlap_degree >= 2
+
+
+# ---------------------------------------------------------------------------
+# R1 — slice well-formedness
+# ---------------------------------------------------------------------------
+
+
+def test_r1_negative_range(plan):
+    calc = copy.deepcopy(plan["calc"])
+    arg = next(a for a in calc.host_args if a.num_slices)
+    arg.q_ranges[0, 0] = -3
+    report = verify_plan(calc_meta=calc)
+    assert report.fired_rules() == {"R1"}
+    assert not report.ok()
+
+
+def test_r1_inverted_range(plan):
+    calc = copy.deepcopy(plan["calc"])
+    arg = next(a for a in calc.merged_args if a.num_slices)
+    arg.k_ranges[0] = (50, 10)
+    report = verify_plan(calc_meta=calc)
+    assert report.fired_rules() == {"R1"}
+    assert not report.ok()
+
+
+def test_r1_out_of_bounds_slice(plan):
+    calc = copy.deepcopy(plan["calc"])
+    arg = next(a for a in calc.host_args if a.num_slices)
+    arg.k_ranges[0, 1] = arg.total_seqlen_k + 64
+    report = verify_plan(calc_meta=calc)
+    assert report.fired_rules() == {"R1"}
+    assert not report.ok()
+
+
+def test_r1_global_slices_beyond_seqlen(plan):
+    qr = AttnRanges.from_ranges([[0, SEQ + 128]])
+    report = verify_plan(
+        global_slices=(qr, plan["kr"], plan["mt"], SEQ, SEQ)
+    )
+    assert report.fired_rules() == {"R1"}
+    assert not report.ok()
+
+
+# ---------------------------------------------------------------------------
+# R2 — dispatch partition
+# ---------------------------------------------------------------------------
+
+
+def test_r2_dropped_chunk(plan):
+    mq = copy.deepcopy(plan["mq"])
+    mq.partitions[0].pop()
+    report = verify_plan(dispatch_meta=mq, bucket=plan["bucket"])
+    assert report.fired_rules() == {"R2"}
+    assert not report.ok()
+    assert any("never dispatched" in v.detail for v in report.errors())
+
+
+def test_r2_duplicated_chunk(plan):
+    mq = copy.deepcopy(plan["mq"])
+    mq.partitions[0][-1] = mq.partitions[1][0]
+    report = verify_plan(dispatch_meta=mq, bucket=plan["bucket"])
+    assert report.fired_rules() == {"R2"}
+    assert not report.ok()
+
+
+# ---------------------------------------------------------------------------
+# R3 — zero-redundancy comms
+# ---------------------------------------------------------------------------
+
+
+def _stage_with_traffic(cm):
+    for s in cm.kv_stages:
+        for dst in range(s.send_counts.shape[0]):
+            for src in range(s.send_counts.shape[0]):
+                if s.transfer_table[dst][src].total_seqlen:
+                    return s, dst, src
+    raise AssertionError("no remote traffic in fixture plan")
+
+
+def test_r3_duplicated_cast_rows(plan):
+    cm = copy.deepcopy(plan["cm"])
+    s, dst, src = _stage_with_traffic(cm)
+    dup = s.transfer_table[dst][src][0]
+    s.transfer_table[dst][src].append(AttnRange.from_range(dup))
+    report = _full_verify(plan, comm_meta=cm)
+    assert report.fired_rules() == {"R3"}
+    assert not report.ok()
+
+
+def test_r3_oversized_capacity(plan):
+    cm = copy.deepcopy(plan["cm"])
+    s, _, _ = _stage_with_traffic(cm)
+    s.a_cap += 2 * plan["align"]
+    report = verify_plan(comm_meta=cm, split_alignment=plan["align"])
+    assert report.fired_rules() == {"R3"}
+    assert any(
+        "a_cap" in v.detail for v in report.violations
+    )
+
+
+# ---------------------------------------------------------------------------
+# R4 — overlap staging
+# ---------------------------------------------------------------------------
+
+
+def test_r4_dropped_stage(plan):
+    cm = copy.deepcopy(plan["cm"])
+    cm.kv_stages.pop()
+    report = verify_plan(comm_meta=cm, calc_meta=plan["calc"],
+                         split_alignment=plan["align"])
+    assert report.fired_rules() == {"R4"}
+    assert not report.ok()
+
+
+def test_r4_shrunk_stage_buffer(plan):
+    calc = copy.deepcopy(plan["calc"])
+    calc.recv_len_per_stage[0] -= plan["align"]
+    report = verify_plan(comm_meta=plan["cm"], calc_meta=calc,
+                         split_alignment=plan["align"])
+    assert report.fired_rules() == {"R4"}
+    assert not report.ok()
+
+
+# ---------------------------------------------------------------------------
+# R5 — tile legality
+# ---------------------------------------------------------------------------
+
+
+def test_r5_misaligned_blocks(plan):
+    geom = (SEQ, 4 * SEQ, 128, 128, 2)
+    report = verify_plan(
+        tile_blocks=((100, 512), None, None), tile_geom=geom
+    )
+    assert report.fired_rules() == {"R5"}
+    assert not report.ok()
+    report = verify_plan(
+        tile_blocks=((128, 200), None, None), tile_geom=geom
+    )
+    assert report.fired_rules() == {"R5"}
+
+
+def test_r5_bwd_override_must_divide_fwd_padding(plan):
+    report = verify_plan(
+        tile_blocks=((128, 512), None, (48, 512)),
+        tile_geom=(SEQ, 4 * SEQ, 128, 128, 2),
+    )
+    assert report.fired_rules() == {"R5"}
+    assert any("divide" in v.detail for v in report.errors())
+
+
+def test_r5_clean_blocks(plan):
+    report = verify_plan(
+        tile_blocks=((128, 512), None, (64, 256)),
+        tile_geom=(SEQ, 4 * SEQ, 128, 128, 2),
+    )
+    assert report.fired_rules() == set()
+
+
+# ---------------------------------------------------------------------------
+# dynamic planner
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def dyn_plan(plan):
+    return make_dynamic_attn_plan(
+        plan["qr"], plan["kr"], plan["mt"], plan["mq"], plan["cfg"],
+        dispatch_meta_kv=plan["mkv"],
+    )
+
+
+def test_dynamic_clean(dyn_plan, plan):
+    report = verify_dynamic_plan(dyn_plan, split_alignment=plan["align"])
+    assert report.ok()
+    assert report.fired_rules() == set()
+    assert set(report.rules_run) == {"R1", "R3", "R4"}
+
+
+def test_dynamic_buffer_mutation(dyn_plan, plan):
+    p = copy.deepcopy(dyn_plan)
+    p.q_buf_len += 8
+    report = verify_dynamic_plan(p, split_alignment=plan["align"])
+    assert "R4" in report.fired_rules()
+    assert not report.ok()
+
+
+def test_dynamic_merge_idx_out_of_range(dyn_plan, plan):
+    p = copy.deepcopy(dyn_plan)
+    p.merge_idx = np.array(p.merge_idx, copy=True)
+    p.merge_idx.flat[0] = p.dummy_index + 7
+    report = verify_dynamic_plan(p, split_alignment=plan["align"])
+    assert report.fired_rules() == {"R4"}
+
+
+# ---------------------------------------------------------------------------
+# error raising + report surface
+# ---------------------------------------------------------------------------
+
+
+def test_raise_if_errors_carries_rule_ids(plan):
+    calc = copy.deepcopy(plan["calc"])
+    arg = next(a for a in calc.host_args if a.num_slices)
+    arg.q_ranges[0, 0] = -1
+    report = verify_plan(calc_meta=calc)
+    with pytest.raises(PlanVerificationError, match="R1"):
+        report.raise_if_errors()
+
+
+def test_balance_breach_is_warning_only(plan):
+    # an impossible balance bound trips R2's area check, but as quality
+    # advice (warning), never a correctness error
+    report = verify_plan(
+        dispatch_meta=plan["mq"], bucket=plan["bucket"], balance_bound=1e-9
+    )
+    assert report.fired_rules() == {"R2"}
+    assert report.violations and all(
+        v.severity == "warning" for v in report.violations
+    )
+    report.raise_if_errors()  # warnings never raise
