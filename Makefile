@@ -4,9 +4,9 @@
 PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
-	telemetry-smoke analysis lint verify-plans
+	telemetry-smoke analysis lint verify-plans chaos
 
-test: analysis  ## fast tier: the correctness surface in < 5 min on one core
+test: analysis chaos  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 test-all: analysis  ## everything: + model training, scale oracles, property suites
@@ -40,3 +40,6 @@ fit-overhead:  ## fit tile_policy.OVERHEAD_ELEMS from recorded sweeps
 
 telemetry-smoke:  ## CPU single-step telemetry round trip (JSONL -> report)
 	$(PY) -m pytest tests/test_support/test_telemetry.py -x -q
+
+chaos:  ## fault-injection chaos matrix: every site recovers or raises typed
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience -x -q -m chaos

@@ -53,4 +53,17 @@ def __getattr__(name):
         from . import api
 
         return getattr(api, name)
+    # resilience error types (docs/resilience.md): importable from the top
+    # level so training loops can catch them without knowing the layout
+    if name in (
+        "ResilienceError",
+        "FaultSpecError",
+        "InjectedFault",
+        "NumericGuardError",
+        "FallbackExhaustedError",
+        "UnknownLoweringError",
+    ):
+        from . import resilience
+
+        return getattr(resilience, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -16,6 +16,10 @@ Rules (MAGI-L prefix; all stdlib ``ast``, no third-party linter deps):
 - **MAGI-L004** — every public dataclass in ``meta/collection`` has an
   entry in :data:`~.violation.RULE_COVERAGE`: adding a new plan object
   forces a decision about how the verifier checks it.
+- **MAGI-L005** — every registered fault-injection site
+  (``resilience.inject.INJECTION_SITES``) is exercised somewhere in
+  ``tests/test_resilience/``: a site nobody injects is a recovery path
+  nobody tests, which is how fallback code rots.
 
 Known-legacy findings live in ``lint_baseline.txt`` (``<rule> <relpath>``
 per line) so the linter lands green and only *new* violations fail CI.
@@ -217,12 +221,41 @@ def check_rule_coverage(root: str) -> list[LintFinding]:
     return findings
 
 
+def check_injection_site_coverage(root: str) -> list[LintFinding]:
+    """MAGI-L005: every registered injection site name appears in the
+    chaos suite (``tests/test_resilience/`` next to the package root)."""
+    from ..resilience.inject import INJECTION_SITES
+
+    findings: list[LintFinding] = []
+    inject_rel = os.path.join("resilience", "inject.py")
+    if not os.path.exists(os.path.join(root, inject_rel)):
+        return findings  # linting a foreign tree; the registry isn't there
+    tests_dir = os.path.join(os.path.dirname(root), "tests", "test_resilience")
+    corpus = ""
+    if os.path.isdir(tests_dir):
+        for path in _iter_py_files(tests_dir):
+            with open(path, "r", encoding="utf-8") as f:
+                corpus += f.read()
+    for site in INJECTION_SITES:
+        if site not in corpus:
+            findings.append(
+                LintFinding(
+                    "MAGI-L005", inject_rel, 0,
+                    f"injection site '{site}' has no test in "
+                    "tests/test_resilience/ — every registered site must "
+                    "exercise its documented recover-or-raise path",
+                )
+            )
+    return findings
+
+
 def lint_package(root: str) -> list[LintFinding]:
     """Run every rule over a package directory; findings in path order."""
     findings: list[LintFinding] = []
     for path in _iter_py_files(root):
         findings.extend(lint_file(path, os.path.relpath(path, root)))
     findings.extend(check_rule_coverage(root))
+    findings.extend(check_injection_site_coverage(root))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 

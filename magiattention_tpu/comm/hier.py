@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..common.range import AttnRange
+from ..common.range import AttnRange, RangeError
 from ..common.ranges import AttnRanges
 from .primitives import group_cast_rows
 from .. import telemetry
@@ -299,7 +299,11 @@ def _local_offset(own: AttnRanges, g: AttnRange) -> int:
         if r.start <= g.start < r.end:
             return off + (g.start - r.start)
         off += r.seqlen
-    raise ValueError(f"{g} not owned")
+    raise RangeError(
+        f"global range {g} is not owned by this rank's host ranges "
+        f"{list(own)} — the hierarchical transfer table references rows "
+        "outside the rank's ownership"
+    )
 
 
 def _lookup_merged(
@@ -312,4 +316,8 @@ def _lookup_merged(
     for iv in merged:
         if iv.start <= g.start and g.end <= iv.end:
             return offsets[(src, iv.start)] + (g.start - iv.start)
-    raise ValueError(f"{g} not found in phase-A intervals of src {src}")
+    raise RangeError(
+        f"global range {g} not found in phase-A merged intervals "
+        f"{list(merged)} of src {src} — phase-B indexing would read the "
+        "wrong rows from the inter-host receive buffer"
+    )

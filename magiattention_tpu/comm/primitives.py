@@ -21,7 +21,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience.errors import UnknownLoweringError
 from ..utils.profiling import profile_scope
+
+# the lowering tiers these dispatchers implement; the hierarchical tier
+# ("hier") is routed before primitives are reached (functional/dist_attn.py
+# _cast_kv) and must never fall through to the a2a arm here
+_KNOWN_LOWERINGS = ("a2a", "pp", "ragged")
+
+
+def _check_lowering(kind, dispatcher: str) -> None:
+    if not kind or kind[0] not in _KNOWN_LOWERINGS:
+        raise UnknownLoweringError(
+            f"{dispatcher} received unknown lowering kind {kind!r}; "
+            f"implemented tiers: {', '.join(_KNOWN_LOWERINGS)} — running "
+            "the default collective for an unknown tier would silently "
+            "assemble the wrong receive buffer"
+        )
 
 
 def group_cast_rows(
@@ -165,6 +181,7 @@ def cast_rows(x, ops, kind, axis_name):
     The per-lowering ``group_cast_<kind>`` xprof span (gated on
     MAGI_ATTENTION_PROFILE_MODE) is what the telemetry records' per-stage
     ``lowering_executed`` fields line up with in a trace."""
+    _check_lowering(kind, "cast_rows")
     with profile_scope(f"group_cast_{kind[0]}"):
         if kind[0] == "pp":
             return group_cast_rows_pp(
@@ -179,6 +196,7 @@ def cast_rows(x, ops, kind, axis_name):
 
 def reduce_rows(y, ops, kind, axis_name, shard_len):
     """Transpose dispatcher of :func:`cast_rows`."""
+    _check_lowering(kind, "reduce_rows")
     with profile_scope(f"group_reduce_{kind[0]}"):
         if kind[0] == "pp":
             return group_reduce_rows_pp(

@@ -22,12 +22,19 @@ from .common.enum import AttnMaskType
 from .common.ranges import AttnRanges
 from .config import DistAttnConfig
 from .env import general as env_general
+from .env import resilience as env_resilience
 from .functional.dispatch import dispatch_func, undispatch_func
 from .functional.dist_attn import DistAttnRuntime
 from .meta import (
     make_attn_meta_from_dispatch_meta,
     make_dispatch_meta_from_qk_ranges,
 )
+
+
+def _plan_build_retries() -> int:
+    from .resilience.fallback import PLAN_BUILD_RETRIES
+
+    return PLAN_BUILD_RETRIES
 
 
 def _mesh_signature(mesh: Mesh) -> tuple:
@@ -109,33 +116,47 @@ class DistAttnRuntimeMgr:
                     "MAGI_ATTENTION_HIERARCHICAL_COMM=1 yet; unset one"
                 )
 
-            self.dynamic_plan = make_dynamic_attn_plan(
-                q_ranges, k_ranges, mask_types,
-                self.dispatch_meta_q, key.config,
-                dispatch_meta_kv=self.dispatch_meta_kv,
-            )
-            self.comm_meta = self.calc_meta = None
-            self.runtime = DynamicDistAttnRuntime(
-                plan=self.dynamic_plan, mesh=mesh, cp_axis=key.cp_axis
-            )
-            if telemetry.enabled():
-                p = self.dynamic_plan
-                telemetry.record_event(
-                    "plan_build",
-                    planner="dynamic",
-                    cp_size=key.cp_size,
-                    overlap_degree=1,
-                    stages=[
-                        {"name": name, **cast.telemetry_dict()}
-                        for name, cast in (
-                            ("q_cast", p.q_cast),
-                            ("kv_cast", p.kv_cast),
-                            ("ret", p.ret),
-                        )
-                    ],
+            try:
+                self.dynamic_plan = make_dynamic_attn_plan(
+                    q_ranges, k_ranges, mask_types,
+                    self.dispatch_meta_q, key.config,
+                    dispatch_meta_kv=self.dispatch_meta_kv,
                 )
-            self._maybe_verify()
-            return
+            except Exception as e:
+                # degradation chain 2 (docs/resilience.md): a failed
+                # dynamic solve falls back to the static solver plan —
+                # same mask, kv-comm execution instead of qo-comm
+                if not env_resilience.is_fallback_enable():
+                    raise
+                from .resilience.fallback import record_resilience_event
+
+                record_resilience_event(
+                    "fallback", "dynamic_plan_solve",
+                    action_detail="static_plan", error=type(e).__name__,
+                )
+            else:
+                self.comm_meta = self.calc_meta = None
+                self.runtime = DynamicDistAttnRuntime(
+                    plan=self.dynamic_plan, mesh=mesh, cp_axis=key.cp_axis
+                )
+                if telemetry.enabled():
+                    p = self.dynamic_plan
+                    telemetry.record_event(
+                        "plan_build",
+                        planner="dynamic",
+                        cp_size=key.cp_size,
+                        overlap_degree=1,
+                        stages=[
+                            {"name": name, **cast.telemetry_dict()}
+                            for name, cast in (
+                                ("q_cast", p.q_cast),
+                                ("kv_cast", p.kv_cast),
+                                ("ret", p.ret),
+                            )
+                        ],
+                    )
+                self._maybe_verify()
+                return
 
         self.dynamic_plan = None
         self.comm_meta, self.calc_meta = make_attn_meta_from_dispatch_meta(
@@ -353,7 +374,13 @@ class DistAttnRuntimeDict:
         self._misses += 1
         telemetry.inc("runtime_cache.miss")
         with telemetry.stage_timer("runtime_mgr_init"):
-            mgr = DistAttnRuntimeMgr(key, mesh)
+            try:
+                mgr = self._build_mgr(key, mesh)
+            except Exception:
+                # invariant: a build that raised must never leave an
+                # entry behind — the next get_or_create must rebuild
+                self._d.pop(key, None)
+                raise
         self._d[key] = mgr
         while len(self._d) > self.maxsize:
             self._d.popitem(last=False)
@@ -362,6 +389,37 @@ class DistAttnRuntimeDict:
         if telemetry.enabled():
             telemetry.record_event("runtime_cache", **self.get_stats())
         return mgr
+
+    def _build_mgr(self, key: DistAttnRuntimeKey, mesh: Mesh):
+        """One manager build, with the resilience layer's bounded retry
+        (MAGI_ATTENTION_FALLBACK=1: one extra attempt — enough to absorb
+        a transient plan-build failure, never an infinite loop). The
+        manager class is resolved by NAME at call time so tests can
+        monkeypatch the module global."""
+        retries = (
+            0 if not env_resilience.is_fallback_enable()
+            else _plan_build_retries()
+        )
+        for attempt in range(retries + 1):
+            try:
+                mgr = DistAttnRuntimeMgr(key, mesh)
+            except Exception as e:
+                if attempt >= retries:
+                    raise
+                from .resilience.fallback import record_resilience_event
+
+                record_resilience_event(
+                    "retry", "plan_build", attempt=attempt + 1,
+                    error=type(e).__name__,
+                )
+                continue
+            if attempt:
+                from .resilience.fallback import record_resilience_event
+
+                record_resilience_event(
+                    "recovered", "plan_build", attempt=attempt,
+                )
+            return mgr
 
     def get(self, key: DistAttnRuntimeKey) -> DistAttnRuntimeMgr | None:
         return self._d.get(key)

@@ -123,6 +123,11 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     # wire-tier selection changes the traced collective program
     "MAGI_ATTENTION_RAGGED_GRPCOLL",
     "MAGI_ATTENTION_SPLIT_ALIGNMENT",
+    # resilience: injection/fallback change which plans/kernels actually
+    # run, so cached runtimes must not be shared across flag flips
+    # (MAGI_ATTENTION_NUMERIC_GUARD is a read-only check — excluded)
+    "MAGI_ATTENTION_FAULT_INJECT",
+    "MAGI_ATTENTION_FALLBACK",
 )
 
 

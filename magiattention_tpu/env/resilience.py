@@ -1,0 +1,47 @@
+"""Resilience toggles (docs/resilience.md).
+
+Three flags gate the whole resilience layer (fault injection, numeric
+guards, degradation chains). All default OFF: with every flag unset the
+guarded call paths collapse to the exact pre-resilience code — no clock
+reads, no extra allocation, pinned by
+tests/test_resilience/test_inject.py::test_off_means_noop.
+"""
+
+from __future__ import annotations
+
+from .general import _get_bool, _get_str
+
+
+def fault_inject_spec() -> str:
+    """Fault-injection spec string (resilience/inject.py grammar:
+    ``site[:p=<float>][:seed=<int>][:step=<int>][:count=<int>]``,
+    comma-separated). Empty (default) disables the injector entirely."""
+    return _get_str("MAGI_ATTENTION_FAULT_INJECT", "")
+
+
+def numeric_guard_policy() -> str:
+    """Numeric sentinel policy for attention outputs/LSE:
+    ``""`` (default) — guards off; ``raise`` (or ``1``) — raise a typed
+    NumericGuardError naming the stage; ``record`` — telemetry counter
+    only. Guards force a host sync per step when on."""
+    val = _get_str("MAGI_ATTENTION_NUMERIC_GUARD", "").lower()
+    if val in ("", "0"):
+        return ""
+    return "record" if val == "record" else "raise"
+
+
+def is_fallback_enable() -> bool:
+    """Enable graceful degradation chains (resilience/fallback.py): FFA
+    kernel failures retry down the tile ladder then the sdpa_online
+    reference path; dynamic-plan solve failures fall back to the static
+    solver; runtime plan builds get one bounded retry. Off (default):
+    failures propagate unchanged."""
+    return _get_bool("MAGI_ATTENTION_FALLBACK")
+
+
+def is_resilience_active() -> bool:
+    """ONE gate for the guarded call paths: any of the three flags set.
+    Kept to a few dict lookups so the off path stays free."""
+    return bool(
+        fault_inject_spec() or numeric_guard_policy() or is_fallback_enable()
+    )

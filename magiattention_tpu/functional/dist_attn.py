@@ -37,6 +37,7 @@ from ..comm.primitives import cast_rows, reduce_rows
 from ..env import comm as env_comm
 from ..env import general as env_general
 from ..env import kernel as env_kernel
+from ..env import resilience as env_resilience
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
@@ -349,6 +350,9 @@ class DeferredTilePolicy:
     def _init_tile_policy(self, block_q, block_k) -> None:
         self._plan_sig = None
         self._auto_tile_pending = False
+        # set by the resilience ladder when the FFA path is abandoned for
+        # the reference backend (resilience/fallback.py); wins over env
+        self._backend_override: str | None = None
         # per-pass picks from the auto-tile policy, consumed by the
         # subclasses' _build_plans via _stack_plans (env overrides win)
         self._policy_bwd: tuple = (None, None)
@@ -372,9 +376,22 @@ class DeferredTilePolicy:
         from ..kernels.tile_policy import choose_blocks_per_pass_multi
 
         geoms, sq, sk = self._tile_geoms()
-        (blk_q, blk_k), pol_dq, pol_dkv = choose_blocks_per_pass_multi(
-            geoms, sq, sk, d, dv, itemsize
-        )
+        try:
+            (blk_q, blk_k), pol_dq, pol_dkv = choose_blocks_per_pass_multi(
+                geoms, sq, sk, d, dv, itemsize
+            )
+        except Exception as e:
+            # a failed VMEM scoring pass must not kill the step: the
+            # clamped defaults are always lowerable (docs/resilience.md)
+            if not env_resilience.is_fallback_enable():
+                raise
+            from ..resilience.fallback import record_resilience_event
+
+            record_resilience_event(
+                "recovered", "vmem_check",
+                action_detail="default_blocks", error=type(e).__name__,
+            )
+            (blk_q, blk_k), pol_dq, pol_dkv = (None, None), None, None
         self._policy_bwd = (pol_dq, pol_dkv)
         self._build_plans(blk_q, blk_k)
         self._plan_sig = sig
@@ -683,8 +700,10 @@ class DistAttnRuntime(DeferredTilePolicy):
 
     @property
     def backend(self) -> str:
-        """Kernel backend (env-driven; part of the runtime cache key)."""
-        return env_general.kernel_backend()
+        """Kernel backend (env-driven; part of the runtime cache key).
+        A resilience-ladder override (sticky degradation to the reference
+        path) wins over the env choice."""
+        return self._backend_override or env_general.kernel_backend()
 
     # ------------------------------------------------------------------
 
@@ -724,13 +743,20 @@ class DistAttnRuntime(DeferredTilePolicy):
             (out ``(cp*shard, hq, dv)``, lse ``(cp*shard, hq)`` fp32), same
             sharded layout; plus max_logits when requested.
         """
+        impl = self._calc_attn_impl
+        if env_resilience.is_resilience_active():
+            # guarded path: injection recovery + numeric sentinels
+            # (resilience/fallback.py); never reached with the flags off
+            from ..resilience.fallback import run_calc_attn
+
+            impl = partial(run_calc_attn, self)
         if not telemetry.enabled():
-            return self._calc_attn_impl(q, k, v, return_max_logits)
+            return impl(q, k, v, return_max_logits)
         # wall_ms spans dispatch + (on first call) trace/compile; per-stage
         # DEVICE time lives in the xprof spans the stages' xprof_scope
         # fields name (docs/observability.md)
         with telemetry.stage_timer("calc_attn"):
-            result = self._calc_attn_impl(q, k, v, return_max_logits)
+            result = impl(q, k, v, return_max_logits)
         wall_ms = telemetry.get_collector().gauges.get(
             "time.calc_attn.last_ms"
         )

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm.primitives import cast_rows, reduce_rows
 from ..env import general as env_general
+from ..env import resilience as env_resilience
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
@@ -322,7 +323,9 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
 
     @property
     def backend(self) -> str:
-        return env_general.kernel_backend()
+        # a resilience-ladder override (sticky degradation to the
+        # reference path) wins over the env choice
+        return self._backend_override or env_general.kernel_backend()
 
     @instrument_scope(name="DynamicDistAttnRuntime.calc_attn")
     def calc_attn(
@@ -339,10 +342,16 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
 
         q/k/v: ``(cp*shard, h, d)`` dispatched layout sharded over cp axis.
         """
+        impl = self._calc_attn_impl
+        if env_resilience.is_resilience_active():
+            # guarded path (resilience/fallback.py); dead with flags off
+            from ..resilience.fallback import run_calc_attn
+
+            impl = partial(run_calc_attn, self)
         if not telemetry.enabled():
-            return self._calc_attn_impl(q, k, v, return_max_logits)
+            return impl(q, k, v, return_max_logits)
         with telemetry.stage_timer("calc_attn"):
-            result = self._calc_attn_impl(q, k, v, return_max_logits)
+            result = impl(q, k, v, return_max_logits)
         wall_ms = telemetry.get_collector().gauges.get(
             "time.calc_attn.last_ms"
         )

@@ -54,6 +54,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..env import general as env_general
 from ..env import kernel as env_kernel
+from ..resilience.inject import maybe_inject
 from .ffa_plan import (  # noqa: F401
     IS_FULL,
     DHI,
@@ -1412,6 +1413,7 @@ def ffa_fwd_pallas_dispatch(params: FFAParams, work_qt, work_kt, meta,
     """Forward pallas call with the GQA-packing dispatch applied — the ONE
     entry every forward path (custom-vjp core, CP multi-stage, sink) uses
     so the packed kernel is reachable from all of them."""
+    maybe_inject("kernel_lowering")
     fwd = _ffa_fwd_pallas_gqa if _use_gqa_pack(params) else _ffa_fwd_pallas
     return fwd(params, work_qt, work_kt, meta, q_t, k_t, v_t)
 

@@ -30,6 +30,7 @@ import numpy as np
 
 from .. import telemetry
 from ..env.general import _get_int
+from ..resilience.inject import maybe_inject
 
 NUM_LANES = 128
 # per-grid-step fixed cost in score-element equivalents: ~the VPU work of
@@ -176,6 +177,7 @@ def choose_blocks_multi(
     max-W grid steps): score = max_rank(W) * (bq*bk + OVERHEAD_ELEMS),
     VMEM-guarded. Falls back to the clamped default if every candidate is
     excluded."""
+    maybe_inject("vmem_check")
     seen: set[tuple[int, int]] = set()
     best = None
     best_score = None
@@ -283,6 +285,7 @@ def choose_blocks_per_pass_multi(
     fwd-padded geometry, the same gate :func:`ffa.resolve_bwd_overrides`
     applies to env overrides.
     """
+    maybe_inject("vmem_check")
     cands = _band_candidates(rank_geoms, sq, sk)
 
     def score_pass(kind: str, allowed=None):

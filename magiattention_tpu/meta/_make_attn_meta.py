@@ -14,6 +14,7 @@ from .collection.dispatch_meta import DispatchMeta
 from .collection.dynamic_meta import DynamicAttnPlan
 from .container.bucket import AttnBucket
 from .solver.dist_attn_solver import DistAttnSolver
+from ..resilience.inject import maybe_inject
 from ..utils.profiling import instrument_host
 
 
@@ -24,6 +25,7 @@ def make_attn_meta_from_dispatch_meta(
     config: DistAttnConfig | None = None,
     dispatch_meta_kv: DispatchMeta | None = None,
 ) -> tuple[CommMeta, CalcMeta]:
+    maybe_inject("comm_plan_build")
     config = config or DistAttnConfig()
     solver = DistAttnSolver(
         bucket=bucket,
@@ -47,6 +49,7 @@ def make_dynamic_attn_plan(
     dynamic_attn_solver.py:236 solve — rectangles-based global assignment)."""
     from .solver.dynamic_attn_solver import DynamicAttnSolver
 
+    maybe_inject("dynamic_plan_solve")
     config = config or DistAttnConfig()
     rects = AttnRectangles.from_ranges(q_ranges, k_ranges, attn_mask_type)
     solver = DynamicAttnSolver(

@@ -26,7 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from ...common.enum import DynamicAttnAlgType
-from ...common.range import AttnRange
+from ...common.range import AttnRange, RangeError
 from ...common.ranges import AttnRanges
 from ...common.rectangle import AttnRectangles
 from ...kernels.mask_utils import BAND_INF
@@ -344,7 +344,11 @@ def _local_offset(own: AttnRanges, g: AttnRange) -> int:
         if g.start >= r.start and g.start < r.end:
             return off + (g.start - r.start)
         off += r.seqlen
-    raise ValueError(f"{g} not owned")
+    raise RangeError(
+        f"global range {g} is not owned by this shard's host ranges "
+        f"{list(own)} — the dynamic solver produced an assignment that "
+        "references rows outside the rank's dispatch ownership"
+    )
 
 
 def _make_cast_arg(

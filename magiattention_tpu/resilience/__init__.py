@@ -1,0 +1,41 @@
+"""Resilience layer: fault injection, numeric guards, degradation chains.
+
+See docs/resilience.md. This package must stay import-light: it is pulled
+in by ``comm/primitives.py`` and the functional layer, so importing it
+must not drag in kernels/comm/functional modules (fallback.py lazy-imports
+what it needs inside functions).
+"""
+
+from .errors import (
+    FallbackExhaustedError,
+    FaultSpecError,
+    InjectedFault,
+    NumericGuardError,
+    ResilienceError,
+    UnknownLoweringError,
+)
+from .guards import check_outputs
+from .inject import (
+    INJECTION_SITES,
+    FaultSpec,
+    maybe_inject,
+    parse_fault_spec,
+    reset,
+    should_fire,
+)
+
+__all__ = [
+    "ResilienceError",
+    "FaultSpecError",
+    "InjectedFault",
+    "NumericGuardError",
+    "FallbackExhaustedError",
+    "UnknownLoweringError",
+    "check_outputs",
+    "INJECTION_SITES",
+    "FaultSpec",
+    "maybe_inject",
+    "parse_fault_spec",
+    "reset",
+    "should_fire",
+]

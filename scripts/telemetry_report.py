@@ -175,6 +175,26 @@ def aggregate(records: list[dict]) -> dict:
             "wall_ms_total": sum(walls) if walls else None,
         }
 
+    res = kinds.get("resilience", [])
+    if res:
+        by_action: dict[str, int] = {}
+        hops_by_site: dict[str, int] = {}
+        for r in res:
+            action = r.get("action", "?")
+            by_action[action] = by_action.get(action, 0) + 1
+            if action in ("fallback", "retry"):
+                site = r.get("site", "?")
+                hops_by_site[site] = hops_by_site.get(site, 0) + 1
+        agg["resilience"] = {
+            "events": len(res),
+            "injected": by_action.get("inject", 0),
+            "guard_trips": by_action.get("guard_trip", 0),
+            "fallback_hops": by_action.get("fallback", 0),
+            "retries": by_action.get("retry", 0),
+            "recovered": by_action.get("recovered", 0),
+            "hops_by_site": dict(sorted(hops_by_site.items())),
+        }
+
     hier = kinds.get("hier_plan", [])
     if hier:
         last = hier[-1]
@@ -309,6 +329,18 @@ def format_summary(agg: dict) -> str:
             f"errors={pv['errors_total']} warnings={pv['warnings_total']} "
             f"fired={fired}{wall}"
         )
+
+    rs = agg.get("resilience")
+    if rs:
+        lines.append("")
+        lines.append(
+            f"resilience: injected={rs['injected']} "
+            f"guard_trips={rs['guard_trips']} "
+            f"fallback_hops={rs['fallback_hops']} retries={rs['retries']} "
+            f"recovered={rs['recovered']}"
+        )
+        for site, n in rs["hops_by_site"].items():
+            lines.append(f"  hops at {site}: {n}")
 
     hc = agg.get("hier_comm")
     if hc:

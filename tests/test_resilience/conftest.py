@@ -1,0 +1,65 @@
+"""Shared fixtures for the resilience suite: fresh injector state per test
+and a small helper to build isolated (never cached) runtime managers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.resilience import inject
+
+# small enough for CPU interpret mode, big enough for 4 CP ranks
+S, H, HK, D, CHUNK = 256, 2, 1, 32, 16
+
+RESILIENCE_ENV = (
+    "MAGI_ATTENTION_FAULT_INJECT",
+    "MAGI_ATTENTION_NUMERIC_GUARD",
+    "MAGI_ATTENTION_FALLBACK",
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience_state(monkeypatch):
+    for var in RESILIENCE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    inject.reset()
+    telemetry.reset()
+    yield
+    inject.reset()
+    telemetry.reset()
+
+
+def make_mesh(cp=4):
+    return jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:cp]), axis_names=("cp",)
+    )
+
+
+def make_mgr(seqlen=S, chunk=CHUNK, cp=4):
+    """A FRESH manager (bypasses the module-global runtime dict) so a
+    test's degraded runtime state can never leak into another test."""
+    from magiattention_tpu.api import init_dist_attn_runtime_mgr
+
+    return init_dist_attn_runtime_mgr(
+        [[0, seqlen]], [[0, seqlen]], ["causal"], seqlen, seqlen, chunk,
+        mesh=make_mesh(cp),
+    )
+
+
+def make_qkv(seqlen=S, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((seqlen, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((seqlen, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((seqlen, HK, D)), jnp.float32)
+    return q, k, v
+
+
+def run_step(mgr, seed=0):
+    """dispatch -> calc_attn -> undispatch; returns (out_global, lse_dispatched)."""
+    q, k, v = make_qkv(seed=seed)
+    q_d = mgr.dispatch_qo(q)
+    k_d = mgr.dispatch_kv(k)
+    v_d = mgr.dispatch_kv(v)
+    out_d, lse = mgr.calc_attn(q_d, k_d, v_d)
+    return jax.block_until_ready(mgr.undispatch_qo(out_d)), lse

@@ -1,0 +1,82 @@
+"""Typed error hierarchy: family relationships, top-level exports, and
+the promoted RangeError/UnknownLoweringError call sites (ISSUE 5
+satellite 2)."""
+
+import jax.numpy as jnp
+import pytest
+
+import magiattention_tpu
+from magiattention_tpu.common.range import AttnRange, RangeError
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.resilience.errors import (
+    FallbackExhaustedError,
+    FaultSpecError,
+    InjectedFault,
+    NumericGuardError,
+    ResilienceError,
+    UnknownLoweringError,
+)
+
+
+def test_hierarchy():
+    for err in (FaultSpecError, InjectedFault, NumericGuardError,
+                FallbackExhaustedError, UnknownLoweringError):
+        assert issubclass(err, ResilienceError)
+    assert issubclass(ResilienceError, RuntimeError)
+    # spec/lowering errors double as ValueError for legacy except clauses
+    assert issubclass(FaultSpecError, ValueError)
+    assert issubclass(UnknownLoweringError, ValueError)
+    # the two hierarchies deliberately do not overlap
+    assert not issubclass(RangeError, ResilienceError)
+
+
+def test_top_level_exports():
+    for name in ("ResilienceError", "FaultSpecError", "InjectedFault",
+                 "NumericGuardError", "FallbackExhaustedError",
+                 "UnknownLoweringError"):
+        assert getattr(magiattention_tpu, name) is not None
+
+
+def test_injected_fault_carries_context():
+    e = InjectedFault("vmem_check", 7)
+    assert e.site == "vmem_check" and e.call == 7
+    assert "vmem_check" in str(e) and "MAGI_ATTENTION_FAULT_INJECT" in str(e)
+
+
+def test_solver_local_offset_raises_range_error():
+    from magiattention_tpu.meta.solver.dynamic_attn_solver import (
+        _local_offset,
+    )
+
+    own = AttnRanges.from_ranges([[0, 64], [128, 192]])
+    assert _local_offset(own, AttnRange(130, 140)) == 64 + 2
+    with pytest.raises(RangeError, match="not owned") as ei:
+        _local_offset(own, AttnRange(100, 110))
+    assert "[0, 64)" in str(ei.value)  # offending ownership context
+    assert isinstance(ei.value, ValueError)  # promotion keeps back-compat
+
+
+def test_hier_local_offset_raises_range_error():
+    from magiattention_tpu.comm.hier import _local_offset
+
+    own = AttnRanges.from_ranges([[0, 32]])
+    with pytest.raises(RangeError, match="not owned"):
+        _local_offset(own, AttnRange(40, 48))
+
+
+def test_hier_lookup_merged_raises_range_error():
+    from magiattention_tpu.comm.hier import _lookup_merged
+
+    merged = AttnRanges.from_ranges([[0, 16]])
+    with pytest.raises(RangeError, match="phase-A"):
+        _lookup_merged({}, 3, merged, AttnRange(20, 24))
+
+
+def test_cast_rows_unknown_lowering():
+    from magiattention_tpu.comm.primitives import cast_rows, reduce_rows
+
+    x = jnp.zeros((4, 2))
+    with pytest.raises(UnknownLoweringError, match="cast_rows"):
+        cast_rows(x, (), ("warp",), "cp")
+    with pytest.raises(UnknownLoweringError, match="reduce_rows"):
+        reduce_rows(x, (), ("hier",), "cp", 4)  # hier never reaches here

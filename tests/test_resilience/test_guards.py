@@ -1,0 +1,91 @@
+"""Numeric guard sentinels: policy gating, LSE -inf legality, typed raise
+(docs/resilience.md)."""
+
+import jax.numpy as jnp
+import pytest
+
+from magiattention_tpu.env import resilience as env_resilience
+from magiattention_tpu.resilience.errors import NumericGuardError
+from magiattention_tpu.resilience.guards import check_outputs
+
+FINITE_OUT = jnp.ones((8, 2, 4))
+FINITE_LSE = jnp.zeros((8, 2))
+
+
+def test_policy_parsing(monkeypatch):
+    monkeypatch.delenv("MAGI_ATTENTION_NUMERIC_GUARD", raising=False)
+    assert env_resilience.numeric_guard_policy() == ""
+    monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "0")
+    assert env_resilience.numeric_guard_policy() == ""
+    monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "record")
+    assert env_resilience.numeric_guard_policy() == "record"
+    for truthy in ("1", "raise", "RAISE"):
+        monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", truthy)
+        assert env_resilience.numeric_guard_policy() == "raise"
+
+
+def test_off_accepts_anything(monkeypatch):
+    monkeypatch.delenv("MAGI_ATTENTION_NUMERIC_GUARD", raising=False)
+    bad = FINITE_OUT.at[0, 0, 0].set(jnp.nan)
+    check_outputs("stage", bad, FINITE_LSE)  # no raise: guard is off
+
+
+def test_raise_on_nan_out(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+    bad = FINITE_OUT.at[1, 0, 2].set(jnp.nan)
+    with pytest.raises(NumericGuardError, match="my_stage") as ei:
+        check_outputs("my_stage", bad, FINITE_LSE)
+    assert ei.value.stage == "my_stage"
+    assert "out" in ei.value.detail
+
+
+def test_raise_on_inf_out(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+    bad = FINITE_OUT.at[0, 1, 0].set(-jnp.inf)
+    with pytest.raises(NumericGuardError):
+        check_outputs("s", bad, None)
+
+
+def test_lse_minus_inf_is_legal(monkeypatch):
+    # a fully-masked row's log-sum-exp IS -inf: the guard must not trip
+    monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+    lse = FINITE_LSE.at[3, 0].set(-jnp.inf)
+    check_outputs("s", FINITE_OUT, lse)  # no raise
+
+
+def test_lse_nan_and_plus_inf_trip(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+    for bad_val in (jnp.nan, jnp.inf):
+        lse = FINITE_LSE.at[0, 0].set(bad_val)
+        with pytest.raises(NumericGuardError, match="lse"):
+            check_outputs("s", FINITE_OUT, lse)
+
+
+def test_record_policy_never_raises(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "record")
+    bad = FINITE_OUT.at[0, 0, 0].set(jnp.nan)
+    check_outputs("s", bad, FINITE_LSE)  # recorded, not raised
+
+
+def test_record_policy_emits_telemetry(monkeypatch, tmp_path):
+    import glob
+    import json
+
+    from magiattention_tpu import telemetry
+
+    monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "record")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        check_outputs("stage_x", FINITE_OUT.at[0, 0, 0].set(jnp.nan), None)
+    finally:
+        telemetry.reset()
+    records = []
+    for path in glob.glob(str(tmp_path / "*.jsonl")):
+        with open(path) as f:
+            records += [json.loads(ln) for ln in f if ln.strip()]
+    trips = [r for r in records if r.get("kind") == "resilience"]
+    assert trips and trips[-1]["action"] == "guard_trip"
+    assert trips[-1]["stage"] == "stage_x"
+    assert trips[-1]["bad_out"] is True

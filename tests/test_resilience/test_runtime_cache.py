@@ -1,0 +1,80 @@
+"""Regression (ISSUE 5 satellite 1): a plan build that raises can never
+leave a partially-built entry in the runtime LRU cache, and the bounded
+retry only engages under MAGI_ATTENTION_FALLBACK."""
+
+import pytest
+
+import magiattention_tpu.dist_attn_runtime_mgr as mgr_mod
+
+
+class _FlakyBuilder:
+    """Stand-in for DistAttnRuntimeMgr that fails the first N builds."""
+
+    def __init__(self, fail_first_n: int):
+        self.fail_first = fail_first_n
+        self.attempts = 0
+
+    def __call__(self, key, mesh):
+        self.attempts += 1
+        if self.attempts <= self.fail_first:
+            raise RuntimeError(f"build blew up (attempt {self.attempts})")
+        return object()
+
+
+def test_failed_build_never_cached(monkeypatch):
+    flaky = _FlakyBuilder(fail_first_n=1)
+    monkeypatch.setattr(mgr_mod, "DistAttnRuntimeMgr", flaky)
+    monkeypatch.delenv("MAGI_ATTENTION_FALLBACK", raising=False)
+    d = mgr_mod.DistAttnRuntimeDict(maxsize=4)
+    with pytest.raises(RuntimeError, match="blew up"):
+        d.get_or_create("key-a", None)
+    assert len(d) == 0 and d.get("key-a") is None
+    # the next call must REBUILD (a cached broken entry would skip this)
+    assert d.get_or_create("key-a", None) is not None
+    assert flaky.attempts == 2
+    assert d.get_stats()["misses"] == 2  # the failed build was a miss too
+
+
+def test_retry_only_with_fallback_enabled(monkeypatch):
+    monkeypatch.delenv("MAGI_ATTENTION_FALLBACK", raising=False)
+    flaky = _FlakyBuilder(fail_first_n=1)
+    monkeypatch.setattr(mgr_mod, "DistAttnRuntimeMgr", flaky)
+    d = mgr_mod.DistAttnRuntimeDict(maxsize=4)
+    with pytest.raises(RuntimeError):
+        d.get_or_create("k", None)
+    assert flaky.attempts == 1  # no silent retry without the flag
+
+
+def test_bounded_retry_with_fallback(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+    flaky = _FlakyBuilder(fail_first_n=1)
+    monkeypatch.setattr(mgr_mod, "DistAttnRuntimeMgr", flaky)
+    d = mgr_mod.DistAttnRuntimeDict(maxsize=4)
+    assert d.get_or_create("k", None) is not None  # retry absorbed it
+    assert flaky.attempts == 2
+    assert len(d) == 1
+
+
+def test_retry_budget_is_bounded(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+    flaky = _FlakyBuilder(fail_first_n=100)
+    monkeypatch.setattr(mgr_mod, "DistAttnRuntimeMgr", flaky)
+    d = mgr_mod.DistAttnRuntimeDict(maxsize=4)
+    with pytest.raises(RuntimeError):
+        d.get_or_create("k", None)
+    # 1 + PLAN_BUILD_RETRIES attempts, never an unbounded loop
+    from magiattention_tpu.resilience.fallback import PLAN_BUILD_RETRIES
+
+    assert flaky.attempts == 1 + PLAN_BUILD_RETRIES
+    assert len(d) == 0
+
+
+def test_monkeypatched_builder_still_supported(monkeypatch):
+    # the telemetry suite patches the module-global class with a lambda;
+    # the retry helper must resolve the name at call time (regression)
+    monkeypatch.setattr(
+        mgr_mod, "DistAttnRuntimeMgr", lambda key, mesh: object()
+    )
+    d = mgr_mod.DistAttnRuntimeDict(maxsize=2)
+    assert d.get_or_create("a", None) is not None
+    assert d.get_stats()["misses"] == 1

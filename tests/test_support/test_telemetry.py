@@ -123,6 +123,15 @@ def test_report_cli_round_trip(tmp_path, monkeypatch, capsys):
     # distinct chunking: the module-global runtime dict caches the other
     # test's key, and a cache hit would skip the plan-build records
     _run_step(chunk=48)
+    # synthetic resilience records: the report's resilience section must
+    # round-trip alongside the real step records
+    telemetry.record_event(
+        "resilience", action="inject", site="kernel_lowering", call=1
+    )
+    telemetry.record_event(
+        "resilience", action="fallback", site="kernel_lowering",
+        action_detail="ladder_start",
+    )
     telemetry.reset()  # flush/close before the reader opens the file
 
     mod = load_script(REPORT, "telemetry_report")
@@ -134,8 +143,14 @@ def test_report_cli_round_trip(tmp_path, monkeypatch, capsys):
     assert 0.0 < agg["dispatch"]["balance_ratio"] <= 1.0
     assert agg["attn_step"]["steps"] >= 1
     assert agg["runtime_cache"]["misses"] >= 1
+    assert agg["resilience"] == {
+        "events": 2, "injected": 1, "guard_trips": 0, "fallback_hops": 1,
+        "retries": 0, "recovered": 0,
+        "hops_by_site": {"kernel_lowering": 1},
+    }
     text = mod.format_summary(agg)
-    for token in ("balance_ratio", "attn steps", "runtime cache", "stage 0"):
+    for token in ("balance_ratio", "attn steps", "runtime cache", "stage 0",
+                  "resilience"):
         assert token in text
 
     assert mod.main([str(tmp_path)]) == 0
