@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
-	telemetry-smoke analysis lint verify-plans chaos
+	telemetry-smoke analysis lint verify-plans kernel-audit chaos
 
 test: analysis chaos  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -12,13 +12,17 @@ test: analysis chaos  ## fast tier: the correctness surface in < 5 min on one co
 test-all: analysis  ## everything: + model training, scale oracles, property suites
 	$(PY) -m pytest tests/ -q
 
-analysis: lint verify-plans  ## static passes: AST repo linter + plan verifier
+analysis: lint verify-plans kernel-audit  ## static passes: linter + plan verifier + kernel contract audit
 
 lint:  ## AST repo rules (analysis/lint.py) over the package, with baseline
 	$(PY) -m magiattention_tpu.analysis.lint
 
 verify-plans:  ## R1-R5 plan verifier over the golden solver corpus (CPU)
 	JAX_PLATFORMS=cpu $(PY) scripts/verify_plans.py
+
+kernel-audit:  ## K1-K5 kernel contract audit over the golden config corpus (CPU)
+	JAX_PLATFORMS=cpu $(PY) scripts/kernel_audit.py
+	JAX_PLATFORMS=cpu $(PY) scripts/kernel_audit.py --selftest
 
 test-slow:  ## only the slow tier (training / 262k-131k oracles / property)
 	$(PY) -m pytest tests/ -q -m slow

@@ -1,20 +1,31 @@
-"""Static analysis layer: plan verifier + repo-rule linter.
+"""Static analysis layer: plan verifier + kernel contract checker + linter.
 
-Two complementary passes turn the codebase's implicit contracts into
-machine-checked ones (see docs/plan_invariants.md):
+Three complementary passes turn the codebase's implicit contracts into
+machine-checked ones (see docs/plan_invariants.md and
+docs/kernel_contracts.md):
 
 - :mod:`verifier` proves (or reports violations of) the named rule set
   R1-R5 over already-constructed plan metadata — slices, ``DispatchMeta``,
   ``CommMeta``/``GroupCollectiveArg``, ``CalcMeta``, ``DynamicAttnPlan``
   and tile choices — before any collective runs.
+- :mod:`kernel_check` proves the named rule set K1-K5 over every
+  ``pl.pallas_call`` site in ``kernels/``: VMEM residency, accumulator
+  init/flush discipline, index-map bounds, dtype/precision, and
+  cache-key soundness — abstractly, without executing kernel bodies.
 - :mod:`lint` is an AST-based linter enforcing codebase rules (no raw
   ``os.environ`` outside ``env/``, no host clocks in kernels/functional,
   no ``print`` in library code, every public ``meta/collection`` dataclass
-  covered by a verifier rule).
+  covered by a verifier rule, every env key documented).
 
-Entry points: ``make analysis``, ``scripts/verify_plans.py`` (golden
-corpus), and the opt-in runtime hook ``MAGI_ATTENTION_VERIFY_PLANS=1``
-(``dist_attn_runtime_mgr`` -> :func:`maybe_verify_runtime`).
+Entry points: ``make analysis``, ``scripts/verify_plans.py`` and
+``scripts/kernel_audit.py`` (golden corpora), and the opt-in runtime hook
+``MAGI_ATTENTION_VERIFY_PLANS=1`` (``dist_attn_runtime_mgr`` ->
+:func:`maybe_verify_runtime`).
+
+:mod:`kernel_check` is re-exported lazily (PEP 562 ``__getattr__``): it
+imports ``kernels.tile_policy`` at module scope and jax inside functions,
+and eagerly importing it here would tax every jax-free consumer of the
+violation registry.
 """
 
 from .violation import (  # noqa: F401
@@ -29,3 +40,25 @@ from .verifier import (  # noqa: F401
     verify_dynamic_plan,
     verify_plan,
 )
+
+_KERNEL_CHECK_EXPORTS = frozenset(
+    {
+        "capture_ffa_contracts",
+        "check_contract",
+        "check_env_keys",
+        "check_kernel_sources",
+        "check_reachable_space",
+        "discover_pallas_sites",
+        "golden_corpus",
+        "run_kernel_audit",
+        "run_seeded_mutations",
+    }
+)
+
+
+def __getattr__(name: str):
+    if name in _KERNEL_CHECK_EXPORTS:
+        from . import kernel_check
+
+        return getattr(kernel_check, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,1383 @@
+"""Static Pallas kernel contract checker (rules K1-K5).
+
+PR 3's verifier proves plan-level invariants (R1-R5); this module proves
+the KERNEL level: every ``pl.pallas_call`` site under ``kernels/`` is
+discovered by AST, its contract (grid, BlockSpec shapes + index_maps,
+scratch, dtypes) is reconstructed by interception — the wrapper functions
+are driven with real plans and dummy operands while ``pallas_call`` is
+replaced by a recorder, so the kernel bodies never execute — and the
+contract is checked against five rule families:
+
+- **K1** VMEM budget: the exact per-step residency (double-buffered
+  in/out blocks + scratch + score-tile intermediates) fits the per-core
+  budget with headroom. ONE model backs every layer:
+  ``utils/mem_budget.ffa_kernel_residency`` is asserted here to match the
+  captured contracts bit-for-bit, the packed-kernel dispatch guards in
+  ``kernels/ffa.py`` call it, and the tile policy's candidate filter
+  (guarded by the ``vmem_check`` fault-injection site) is asserted equal
+  to ``mem_budget.ffa_vmem_budget``/``ffa_bwd_vmem_budget``. The
+  abstract sweep (:func:`check_reachable_space`) closes the proof over
+  the FULL config space ``tile_policy.reachable_block_space`` can emit —
+  not just the sampled corpus.
+- **K2** accumulator discipline (source-level, driven by
+  ``kernels/ffa.py:PALLAS_CONTRACTS``): every cross-step scratch
+  accumulator is zero-initialized under the is-first guard — qualified
+  on the innermost grid position when the grid revisits tiles — and
+  every output ref is stored exactly once, under the is-last guard (the
+  dkv-GQA-pack bug class).
+- **K3** index-map bounds: every index_map output x block shape stays
+  inside its operand for ALL grid points (vectorized numpy evaluation of
+  the captured index_map lambdas over the whole grid).
+- **K4** dtype/precision: fp32 accumulator scratch, fp32-preferred
+  ``dot_general``s, declared out dtypes honored (no implicit f32->bf16
+  truncation before the final guarded write).
+- **K5** cache-key soundness: every env key consumed under ``kernels/``
+  appears in ``ENV_KEYS_AFFECTING_RUNTIME`` or the audited allowlist of
+  keys proven not to change lowering.
+
+Violations reuse the :mod:`violation` registry; ``scripts/kernel_audit.py``
+sweeps the golden corpus and ``make kernel-audit`` gates ``make test`` on
+a clean run. See docs/kernel_contracts.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field, replace  # noqa: F401 (replace: test API)
+from pathlib import Path
+
+import numpy as np
+
+from ..kernels.tile_policy import VMEM_BUDGET as POLICY_VMEM_BUDGET
+from ..utils.mem_budget import (
+    VMEM_ALLOWED_BYTES,
+    VMEM_HEADROOM_BYTES,
+    VMEM_LIMIT_BYTES,
+    ffa_bwd_vmem_budget,
+    ffa_kernel_residency,
+    ffa_vmem_budget,
+)
+from .violation import ERROR, VerifyReport
+
+__all__ = [
+    "AuditSpec",
+    "KernelContract",
+    "PallasSite",
+    "POLICY_VMEM_BUDGET",
+    "VMEM_ALLOWED_BYTES",
+    "VMEM_HEADROOM_BYTES",
+    "VMEM_LIMIT_BYTES",
+    "K5_ALLOWLIST",
+    "bwd_vmem_bytes",
+    "capture_ffa_contracts",
+    "check_contract",
+    "check_env_keys",
+    "check_kernel_sources",
+    "check_reachable_space",
+    "discover_pallas_sites",
+    "fwd_vmem_bytes",
+    "golden_corpus",
+    "padding_stats",
+    "run_kernel_audit",
+    "run_seeded_mutations",
+]
+
+# env keys consumed under kernels/ that are PROVEN not to change kernel
+# lowering and are therefore exempt from ENV_KEYS_AFFECTING_RUNTIME
+# membership (K5). Every entry carries its proof obligation.
+K5_ALLOWLIST: dict[str, str] = {
+    "MAGI_ATTENTION_NATIVE_FFA_PLAN": (
+        "selects the native-C vs pure-Python FFA plan builder; both emit "
+        "identical work-item arrays (parity pinned by the plan tests), so "
+        "the traced kernel program cannot differ"
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# the shared VMEM model (verifier R5 delegates here — satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def fwd_vmem_bytes(
+    bq: int, bk: int, d: int, dv: int | None = None, itemsize: int = 2
+) -> int:
+    """Estimated fwd per-step residency — the tile policy's filter model."""
+    return ffa_vmem_budget(bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize)
+
+
+def bwd_vmem_bytes(
+    kind: str, bq: int, bk: int, d: int, dv: int | None = None,
+    itemsize: int = 2,
+) -> int:
+    """Estimated bwd per-step residency — the tile policy's filter model."""
+    return ffa_bwd_vmem_budget(
+        kind, bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize
+    )
+
+
+# ---------------------------------------------------------------------------
+# discovery (AST)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PallasSite:
+    """One ``pl.pallas_call`` site in the kernels package."""
+
+    relpath: str
+    line: int
+    wrapper: str  # enclosing function
+    kernel_name: str  # the kernel body passed (resolved through partial)
+
+
+def _kernels_dir() -> Path:
+    return Path(__file__).resolve().parents[1] / "kernels"
+
+
+def discover_pallas_sites(kernels_dir: str | Path | None = None) -> list[PallasSite]:
+    """Every ``*.pallas_call`` call site under ``kernels/``, with the kernel
+    body name resolved through local ``kernel = partial(<fn>, ...)``
+    assignments inside the enclosing wrapper."""
+    root = Path(kernels_dir) if kernels_dir else _kernels_dir()
+    sites: list[PallasSite] = []
+    for path in sorted(root.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            partials: dict[str, str] = {}
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and _callee_name(node.value.func) == "partial"
+                    and node.value.args
+                    and isinstance(node.value.args[0], ast.Name)
+                ):
+                    partials[node.targets[0].id] = node.value.args[0].id
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "pallas_call"
+                ):
+                    kernel = "<unknown>"
+                    if node.args:
+                        arg = node.args[0]
+                        if isinstance(arg, ast.Name):
+                            kernel = partials.get(arg.id, arg.id)
+                        elif (
+                            isinstance(arg, ast.Call)
+                            and _callee_name(arg.func) == "partial"
+                            and arg.args
+                            and isinstance(arg.args[0], ast.Name)
+                        ):
+                            kernel = arg.args[0].id
+                    sites.append(
+                        PallasSite(
+                            relpath=f"kernels/{path.name}",
+                            line=node.lineno,
+                            wrapper=fn.name,
+                            kernel_name=kernel,
+                        )
+                    )
+    return sites
+
+
+def _callee_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# contract capture (pallas_call interception)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KernelContract:
+    """The reconstructed contract of one pallas_call at one config."""
+
+    kernel_name: str
+    grid: tuple[int, ...]
+    num_scalar_prefetch: int
+    in_specs: tuple  # of pl.BlockSpec (block_shape + index_map introspected)
+    out_specs: tuple
+    scratch: tuple[tuple[tuple[int, ...], str], ...]  # (shape, dtype)
+    out_shape: tuple[tuple[tuple[int, ...], str], ...]
+    prefetch: tuple[np.ndarray, ...]  # concrete scalar-prefetch operands
+    operands: tuple[tuple[tuple[int, ...], str], ...]  # tensor (shape, dtype)
+
+
+class _Captured(Exception):
+    pass
+
+
+class _capture_pallas:
+    """Context manager replacing ``pallas.pallas_call`` with a recorder:
+    the returned callable snapshots the full contract at call time and
+    raises, so no kernel is ever lowered or executed."""
+
+    def __init__(self) -> None:
+        self.contracts: list[KernelContract] = []
+
+    def __enter__(self) -> "_capture_pallas":
+        from jax.experimental import pallas as pl_mod
+
+        self._mod = pl_mod
+        self._real = pl_mod.pallas_call
+        contracts = self.contracts
+
+        def recorder(kernel, *, grid_spec=None, out_shape=None, **_kw):
+            def runner(*args):
+                gs = grid_spec
+                nsp = int(getattr(gs, "num_scalar_prefetch", 0))
+                kname = getattr(
+                    getattr(kernel, "func", kernel), "__name__", str(kernel)
+                )
+                oshape = (
+                    list(out_shape)
+                    if isinstance(out_shape, (list, tuple))
+                    else [out_shape]
+                )
+                out_specs = gs.out_specs
+                if not isinstance(out_specs, (list, tuple)):
+                    out_specs = (out_specs,)
+                contracts.append(
+                    KernelContract(
+                        kernel_name=kname,
+                        grid=tuple(int(dim) for dim in gs.grid),
+                        num_scalar_prefetch=nsp,
+                        in_specs=tuple(gs.in_specs),
+                        out_specs=tuple(out_specs),
+                        scratch=tuple(
+                            (tuple(s.shape), np.dtype(s.dtype).name)
+                            for s in gs.scratch_shapes
+                        ),
+                        out_shape=tuple(
+                            (tuple(o.shape), np.dtype(o.dtype).name)
+                            for o in oshape
+                        ),
+                        prefetch=tuple(np.asarray(a) for a in args[:nsp]),
+                        operands=tuple(
+                            (tuple(a.shape), np.dtype(a.dtype).name)
+                            for a in args[nsp:]
+                        ),
+                    )
+                )
+                raise _Captured(kname)
+
+            return runner
+
+        pl_mod.pallas_call = recorder
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._mod.pallas_call = self._real
+
+
+# ---------------------------------------------------------------------------
+# audit specs + capture drivers
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, eq=False)
+class AuditSpec:
+    """One golden-corpus configuration to capture contracts at."""
+
+    name: str
+    q_ranges: np.ndarray
+    k_ranges: np.ndarray
+    d_lo: np.ndarray
+    d_hi: np.ndarray
+    sq: int
+    sk: int
+    hq: int
+    hk: int
+    blocks: tuple[int, int]
+    d: int = 128
+    dv: int = 128
+    dtype: str = "bfloat16"
+    dq_blocks: tuple[int, int] | None = None
+    dkv_blocks: tuple[int, int] | None = None
+    emit_ml: bool = False
+
+
+def capture_ffa_contracts(spec: AuditSpec) -> list[KernelContract]:
+    """Drive every FFA wrapper applicable at ``spec`` under capture.
+
+    Applicability mirrors the runtime dispatch predicates in
+    ``kernels/ffa.py`` minus their env flags (the audit proves every
+    kernel a flag COULD route to), so a config the packed guards refuse
+    is audited on the unpacked path only — exactly like the runtime.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import ffa
+    from ..kernels.ffa_plan import get_ffa_plan
+
+    bq, bk = spec.blocks
+    plan = get_ffa_plan(
+        spec.q_ranges, spec.k_ranges, spec.d_lo, spec.d_hi,
+        spec.sq, spec.sk, bq, bk,
+    )
+    sqp = plan.num_q_tiles * bq
+    skp = plan.num_k_tiles * bk
+    g = spec.hq // spec.hk
+    itemsize = jnp.dtype(spec.dtype).itemsize
+
+    arrays = ffa.plan_arrays(plan)
+    dq_triple, dkv_triple = arrays[0:3], arrays[3:6]
+    overrides: dict = {}
+    if spec.dq_blocks:
+        plan_dq = get_ffa_plan(
+            spec.q_ranges, spec.k_ranges, spec.d_lo, spec.d_hi,
+            spec.sq, spec.sk, *spec.dq_blocks,
+        )
+        dq_triple = ffa.plan_arrays(plan_dq)[0:3]
+        overrides.update(
+            block_q_dq=spec.dq_blocks[0], block_k_dq=spec.dq_blocks[1],
+            num_work_dq=plan_dq.num_work,
+        )
+    if spec.dkv_blocks:
+        plan_dkv = get_ffa_plan(
+            spec.q_ranges, spec.k_ranges, spec.d_lo, spec.d_hi,
+            spec.sq, spec.sk, *spec.dkv_blocks,
+        )
+        dkv_triple = ffa.plan_arrays(plan_dkv)[3:6]
+        overrides.update(
+            block_q_dkv=spec.dkv_blocks[0], block_k_dkv=spec.dkv_blocks[1],
+            num_work_dkv=plan_dkv.num_work_t,
+        )
+
+    params = ffa.FFAParams(
+        num_work=plan.num_work,
+        num_work_t=plan.num_work_t,
+        num_q_tiles=plan.num_q_tiles,
+        num_k_tiles=plan.num_k_tiles,
+        block_q=bq,
+        block_k=bk,
+        softmax_scale=float(spec.d) ** -0.5,
+        softcap=0.0,
+        group=g,
+        interpret=True,
+        emit_max_logits=spec.emit_ml,
+        **overrides,
+    )
+    dtype = jnp.dtype(spec.dtype)
+    q_t = jnp.zeros((spec.hq, sqp, spec.d), dtype)
+    k_t = jnp.zeros((spec.hk, skp, spec.d), dtype)
+    v_t = jnp.zeros((spec.hk, skp, spec.dv), dtype)
+    do_t = jnp.zeros((spec.hq, sqp, spec.dv), dtype)
+    lse_t = jnp.zeros((spec.hq, sqp), jnp.float32)
+    delta_t = jnp.zeros((spec.hq, sqp), jnp.float32)
+
+    def pack_ok(kind: str, kbq: int, kbk: int) -> bool:
+        return (
+            g > 1
+            and sqp % kbq == 0
+            and ffa_kernel_residency(
+                kind, kbq, kbk, spec.d, head_dim_v=spec.dv,
+                dtype_bytes=itemsize, group=g, packed=True,
+            )
+            <= VMEM_ALLOWED_BYTES
+        )
+
+    runs: list[tuple] = [
+        (ffa._ffa_fwd_pallas, (params, *arrays[0:3], q_t, k_t, v_t)),
+        (ffa._ffa_bwd_dq_pallas,
+         (params, *dq_triple, q_t, k_t, v_t, do_t, lse_t, delta_t)),
+        (ffa._ffa_bwd_dkv_pallas,
+         (params, *dkv_triple, q_t, k_t, v_t, do_t, lse_t, delta_t)),
+    ]
+    if g > 1 and not spec.emit_ml and pack_ok("fwd", bq, bk):
+        runs.append(
+            (ffa._ffa_fwd_pallas_gqa, (params, *arrays[0:3], q_t, k_t, v_t))
+        )
+    if pack_ok("dq", *params.dq_blocks()):
+        runs.append(
+            (ffa._ffa_bwd_dq_pallas_gqa,
+             (params, *dq_triple, q_t, k_t, v_t, do_t, lse_t, delta_t))
+        )
+    if pack_ok("dkv", *params.dkv_blocks()):
+        runs.append(
+            (ffa._ffa_bwd_dkv_pallas_gqa,
+             (params, *dkv_triple, q_t, k_t, v_t, do_t, lse_t, delta_t))
+        )
+
+    contracts: list[KernelContract] = []
+    with jax.default_device(jax.devices("cpu")[0]):
+        for fn, args in runs:
+            cap = _capture_pallas()
+            with cap:
+                try:
+                    fn(*args)
+                except _Captured:
+                    pass
+            contracts.extend(cap.contracts)
+    return contracts
+
+
+# ---------------------------------------------------------------------------
+# contract geometry helpers
+# ---------------------------------------------------------------------------
+
+
+def _contract_shape_info(contract: KernelContract) -> dict:
+    """(kind, packed, g, bq, bk, d, dv, itemsize, emit_ml) derived from the
+    captured blocks — no reliance on the driver's inputs, so the checks
+    also apply to synthetic/mutated contracts in tests."""
+    name = contract.kernel_name
+    packed = name.endswith("_gqa")
+    kind = (
+        "fwd" if "fwd" in name else "dq" if "dq" in name else "dkv"
+    )
+    q_block = contract.in_specs[0].block_shape
+    k_block = contract.in_specs[1].block_shape
+    v_block = contract.in_specs[2].block_shape
+    if packed:
+        g, bq, d = int(q_block[1]), int(q_block[2]), int(q_block[3])
+    else:
+        g, bq, d = 1, int(q_block[1]), int(q_block[2])
+    bk = int(k_block[1])
+    dv = int(v_block[2])
+    itemsize = np.dtype(contract.operands[0][1]).itemsize
+    emit_ml = kind == "fwd" and not packed and len(contract.out_shape) == 3
+    return dict(
+        kind=kind, packed=packed, g=g, bq=bq, bk=bk, d=d, dv=dv,
+        itemsize=itemsize, emit_ml=emit_ml,
+    )
+
+
+def _block_bytes(block_shape, dtype_name: str) -> int:
+    n = 1
+    for dim in block_shape:
+        if dim is not None:
+            n *= int(dim)
+    return n * np.dtype(dtype_name).itemsize
+
+
+def _declared_bytes(contract: KernelContract) -> int:
+    """Exact declared residency from the captured contract: in/out blocks
+    double-buffered + scratch. Scratch is counted at 4 bytes/elem by
+    decree — its DTYPE is K4's rule, so a bf16-scratch mutation fires K4
+    alone, not K1 as a side effect."""
+    total = 0
+    for spec, (_, dtype_name) in zip(
+        contract.in_specs, contract.operands
+    ):
+        total += 2 * _block_bytes(spec.block_shape, dtype_name)
+    for spec, (_, dtype_name) in zip(contract.out_specs, contract.out_shape):
+        total += 2 * _block_bytes(spec.block_shape, dtype_name)
+    for shape, _dtype in contract.scratch:
+        total += int(np.prod(shape)) * 4
+    return total
+
+
+# ---------------------------------------------------------------------------
+# K1 — VMEM budget
+# ---------------------------------------------------------------------------
+
+
+def check_k1_vmem(
+    report: VerifyReport, contract: KernelContract, site: str
+) -> None:
+    report.mark_run("K1")
+    info = _contract_shape_info(contract)
+    declared = _declared_bytes(contract)
+    model_declared = ffa_kernel_residency(
+        info["kind"], info["bq"], info["bk"], info["d"],
+        head_dim_v=info["dv"], dtype_bytes=info["itemsize"],
+        group=info["g"], packed=info["packed"], emit_ml=info["emit_ml"],
+        include_intermediates=False,
+    )
+    model_total = ffa_kernel_residency(
+        info["kind"], info["bq"], info["bk"], info["d"],
+        head_dim_v=info["dv"], dtype_bytes=info["itemsize"],
+        group=info["g"], packed=info["packed"], emit_ml=info["emit_ml"],
+    )
+    intermediates = model_total - model_declared
+    if declared != model_declared:
+        report.add(
+            "K1", ERROR, site,
+            f"residency model drift: mem_budget.ffa_kernel_residency "
+            f"predicts {model_declared} declared bytes but the captured "
+            f"contract holds {declared} — the shared VMEM model no longer "
+            f"matches the real kernel",
+        )
+    total = declared + intermediates
+    if total > VMEM_ALLOWED_BYTES:
+        report.add(
+            "K1", ERROR, site,
+            f"VMEM budget: {total} bytes/step (declared {declared} + "
+            f"intermediates {intermediates}) exceeds the allowed "
+            f"{VMEM_ALLOWED_BYTES} ({VMEM_LIMIT_BYTES} limit - "
+            f"{VMEM_HEADROOM_BYTES} headroom)",
+        )
+    if not info["packed"]:
+        # cross-check against the vmem_check-guarded tile-policy model:
+        # the policy filter and mem_budget must be the SAME arithmetic
+        from ..kernels import tile_policy
+
+        est_policy = (
+            tile_policy._vmem_bytes(
+                info["bq"], info["bk"], info["d"], info["dv"],
+                info["itemsize"],
+            )
+            if info["kind"] == "fwd"
+            else tile_policy._bwd_vmem_bytes(
+                info["kind"], info["bq"], info["bk"], info["d"],
+                info["dv"], info["itemsize"],
+            )
+        )
+        est_budget = (
+            fwd_vmem_bytes(
+                info["bq"], info["bk"], info["d"], info["dv"],
+                info["itemsize"],
+            )
+            if info["kind"] == "fwd"
+            else bwd_vmem_bytes(
+                info["kind"], info["bq"], info["bk"], info["d"],
+                info["dv"], info["itemsize"],
+            )
+        )
+        if est_policy != est_budget:
+            report.add(
+                "K1", ERROR, site,
+                f"policy/runtime VMEM models diverge: tile_policy "
+                f"estimates {est_policy} but mem_budget {est_budget} for "
+                f"the same blocks — the vmem_check site no longer guards "
+                f"the model this checker proves",
+            )
+
+
+def check_reachable_space(
+    report: VerifyReport,
+    sq: int,
+    sk: int,
+    d: int = 128,
+    dv: int = 128,
+    itemsizes: tuple[int, ...] = (2, 4),
+    groups: tuple[int, ...] = (1, 2, 4, 8),
+) -> dict:
+    """Abstract K1 over the FULL reachable config space: every tiling
+    ``tile_policy`` can emit for any pass must keep the UNPACKED kernel
+    residency within budget (unpacked kernels launch unconditionally — no
+    dispatch-time guard protects them), and the packed dispatch guards
+    share :func:`ffa_kernel_residency`, so packed admission is safe by
+    construction (asserted per captured contract in :func:`check_k1_vmem`).
+    Returns sweep stats for the audit report."""
+    from ..kernels import tile_policy
+
+    report.mark_run("K1")
+    checked = 0
+    worst = (0, None)
+    for kind in ("fwd", "dq", "dkv"):
+        for itemsize in itemsizes:
+            space = tile_policy.reachable_block_space(
+                sq, sk, kind, d, dv, itemsize
+            )
+            for bq, bk in space:
+                checked += 1
+                total = ffa_kernel_residency(
+                    kind, bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize,
+                    emit_ml=(kind == "fwd"),
+                )
+                if total > worst[0]:
+                    worst = (total, (kind, bq, bk, itemsize))
+                if total > VMEM_ALLOWED_BYTES:
+                    report.add(
+                        "K1", ERROR,
+                        f"reachable_block_space(sq={sq}, sk={sk}, "
+                        f"{kind}, itemsize={itemsize})",
+                        f"policy-reachable tiling ({bq}, {bk}) puts the "
+                        f"unpacked {kind} kernel at {total} bytes/step > "
+                        f"allowed {VMEM_ALLOWED_BYTES}",
+                    )
+                # packed admission is the guard's decision; prove the
+                # guard's model here so a guard bypass cannot hide
+                for g in groups:
+                    if g == 1:
+                        continue
+                    packed_total = ffa_kernel_residency(
+                        kind, bq, bk, d, head_dim_v=dv,
+                        dtype_bytes=itemsize, group=g, packed=True,
+                    )
+                    admitted = packed_total <= VMEM_ALLOWED_BYTES
+                    if admitted and packed_total > VMEM_ALLOWED_BYTES:
+                        report.add(  # pragma: no cover - tautology guard
+                            "K1", ERROR, "packed dispatch guard",
+                            f"guard admits ({kind}, g={g}, {bq}x{bk}) at "
+                            f"{packed_total} bytes",
+                        )
+    return {
+        "configs_checked": checked,
+        "worst_bytes": worst[0],
+        "worst_config": worst[1],
+        "allowed_bytes": VMEM_ALLOWED_BYTES,
+    }
+
+
+# ---------------------------------------------------------------------------
+# K3 — index-map bounds
+# ---------------------------------------------------------------------------
+
+
+def _grid_mesh(grid: tuple[int, ...]) -> list[np.ndarray]:
+    axes = [np.arange(n, dtype=np.int64) for n in grid]
+    return list(np.meshgrid(*axes, indexing="ij")) if axes else []
+
+
+def _eval_index_map(spec, mesh, prefetch):
+    out = spec.index_map(*mesh, *prefetch)
+    if not isinstance(out, tuple):
+        out = (out,)
+    shape = mesh[0].shape if mesh else ()
+    return [np.broadcast_to(np.asarray(o), shape) for o in out]
+
+
+def check_k3_bounds(
+    report: VerifyReport, contract: KernelContract, site: str
+) -> None:
+    report.mark_run("K3")
+    mesh = _grid_mesh(contract.grid)
+    pairs = [
+        (f"in[{i}]", spec, shape)
+        for i, (spec, (shape, _)) in enumerate(
+            zip(contract.in_specs, contract.operands)
+        )
+    ] + [
+        (f"out[{i}]", spec, shape)
+        for i, (spec, (shape, _)) in enumerate(
+            zip(contract.out_specs, contract.out_shape)
+        )
+    ]
+    for label, spec, op_shape in pairs:
+        block = spec.block_shape
+        if len(block) != len(op_shape):
+            report.add(
+                "K3", ERROR, f"{site} {label}",
+                f"block rank {len(block)} != operand rank {len(op_shape)}",
+            )
+            continue
+        idx = _eval_index_map(spec, mesh, contract.prefetch)
+        if len(idx) != len(block):
+            report.add(
+                "K3", ERROR, f"{site} {label}",
+                f"index_map returns {len(idx)} indices for a rank-"
+                f"{len(block)} block",
+            )
+            continue
+        for axis, (bdim, dim) in enumerate(zip(block, op_shape)):
+            ext = 1 if bdim is None else int(bdim)
+            origin = idx[axis] * (1 if bdim is None else int(bdim))
+            lo = int(origin.min()) if origin.size else 0
+            hi = int(origin.max()) + ext if origin.size else ext
+            if lo < 0 or hi > dim:
+                report.add(
+                    "K3", ERROR, f"{site} {label}",
+                    f"axis {axis}: block [{lo}, {hi}) escapes operand "
+                    f"dim {dim} (block {ext} x index range "
+                    f"[{int(origin.min())}, {int(origin.max())}])",
+                )
+
+
+def padding_stats(
+    contract: KernelContract, sq: int, sk: int
+) -> dict:
+    """Statically counted padded-tile work for the audit report (feeds
+    roadmap item 3's block-skip dispatch): grid steps whose q or k tile
+    sticks out past the true seqlen."""
+    info = _contract_shape_info(contract)
+    if contract.num_scalar_prefetch < 2:
+        return {}
+    work_qt = contract.prefetch[0].astype(np.int64)
+    work_kt = contract.prefetch[1].astype(np.int64)
+    q_pad = (work_qt + 1) * info["bq"] > sq
+    k_pad = (work_kt + 1) * info["bk"] > sk
+    steps = int(work_qt.size)
+    return {
+        "grid_steps": steps,
+        "padded_q_steps": int(q_pad.sum()),
+        "padded_k_steps": int(k_pad.sum()),
+        "padded_steps": int((q_pad | k_pad).sum()),
+        "padded_ratio": float((q_pad | k_pad).sum()) / steps if steps else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# K4 — dtype/precision contract (captured side)
+# ---------------------------------------------------------------------------
+
+
+def check_k4_dtypes(
+    report: VerifyReport, contract: KernelContract, site: str,
+    declared: dict | None = None,
+) -> None:
+    report.mark_run("K4")
+    for i, (shape, dtype_name) in enumerate(contract.scratch):
+        if dtype_name != "float32":
+            report.add(
+                "K4", ERROR, f"{site} scratch[{i}]",
+                f"accumulator scratch {shape} is {dtype_name}, not "
+                f"float32 — cross-step accumulation would truncate",
+            )
+    if declared is None:
+        declared = _pallas_contracts().get(contract.kernel_name)
+    if declared is None:
+        return
+    input_dtype = contract.operands[0][1] if contract.operands else None
+    for i, want in enumerate(declared.get("out_dtypes", ())):
+        if i >= len(contract.out_shape):
+            break  # trailing optional output (ml) absent at this config
+        got = contract.out_shape[i][1]
+        if want == "f32" and got != "float32":
+            report.add(
+                "K4", ERROR, f"{site} out[{i}]",
+                f"declared f32 output lowered as {got} — implicit "
+                f"truncation before the final write",
+            )
+        elif want == "input" and input_dtype and got != input_dtype:
+            report.add(
+                "K4", ERROR, f"{site} out[{i}]",
+                f"passthrough output dtype {got} != operand dtype "
+                f"{input_dtype}",
+            )
+
+
+def _pallas_contracts() -> dict:
+    from ..kernels.ffa import PALLAS_CONTRACTS
+
+    return PALLAS_CONTRACTS
+
+
+def check_contract(
+    report: VerifyReport, contract: KernelContract, site: str | None = None
+) -> None:
+    """K1 + K3 + K4 on one captured contract (K2/K5 are source/repo-level)."""
+    site = site or contract.kernel_name
+    check_k1_vmem(report, contract, site)
+    check_k3_bounds(report, contract, site)
+    check_k4_dtypes(report, contract, site)
+
+
+# ---------------------------------------------------------------------------
+# K2 — accumulator discipline + K4 source rules (AST over kernel bodies)
+# ---------------------------------------------------------------------------
+
+
+def _guard_conds(expr: ast.expr) -> list[tuple[str, str]] | None:
+    """Flatten a ``pl.when`` predicate into (name, rhs) equality pairs;
+    None when the shape is unrecognized."""
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.BitAnd):
+        left = _guard_conds(expr.left)
+        right = _guard_conds(expr.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    if (
+        isinstance(expr, ast.Compare)
+        and len(expr.ops) == 1
+        and isinstance(expr.ops[0], ast.Eq)
+        and isinstance(expr.left, ast.Name)
+    ):
+        return [(expr.left.id, ast.unparse(expr.comparators[0]))]
+    return None
+
+
+def _when_blocks(fn: ast.FunctionDef) -> list[tuple[list, ast.FunctionDef]]:
+    blocks = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.FunctionDef) or node is fn:
+            continue
+        for dec in node.decorator_list:
+            if (
+                isinstance(dec, ast.Call)
+                and isinstance(dec.func, ast.Attribute)
+                and dec.func.attr == "when"
+                and dec.args
+            ):
+                conds = _guard_conds(dec.args[0])
+                if conds is not None:
+                    blocks.append((conds, node))
+    return blocks
+
+
+def _subscript_stores(node: ast.AST, names: tuple[str, ...]) -> dict[str, list]:
+    """name -> list of Assign/AugAssign nodes whose target subscripts it."""
+    stores: dict[str, list] = {n: [] for n in names}
+    for sub in ast.walk(node):
+        targets = []
+        if isinstance(sub, ast.Assign):
+            targets = sub.targets
+        elif isinstance(sub, ast.AugAssign):
+            targets = [sub.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in stores
+            ):
+                stores[t.value.id].append(sub)
+    return stores
+
+
+def check_kernel_sources(
+    report: VerifyReport,
+    source: str | None = None,
+    contracts: dict | None = None,
+    relpath: str = "kernels/ffa.py",
+) -> None:
+    """K2 (+ the source half of K4) over the kernel bodies declared in
+    ``PALLAS_CONTRACTS``. ``source``/``contracts`` default to the real
+    ``kernels/ffa.py``; tests pass mutated fixtures."""
+    report.mark_run("K2")
+    report.mark_run("K4")
+    if contracts is None:
+        contracts = _pallas_contracts()
+    if source is None:
+        source = (_kernels_dir() / "ffa.py").read_text()
+    tree = ast.parse(source)
+    fns = {
+        node.name: node
+        for node in ast.walk(tree)
+        if isinstance(node, ast.FunctionDef)
+    }
+    for kname, decl in contracts.items():
+        site = f"{relpath}:{kname}"
+        fn = fns.get(kname)
+        if fn is None:
+            report.add(
+                "K2", ERROR, site,
+                "annotated kernel body not found in source — "
+                "PALLAS_CONTRACTS out of date",
+            )
+            continue
+        init_guard = decl["init_guard"]
+        flush_guard = decl["flush_guard"]
+        group = decl.get("group_inner")
+
+        # guard vars must be derived from the plan's IS_FIRST / IS_LAST
+        bindings = {}
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                bindings[node.targets[0].id] = ast.unparse(node.value)
+        for var, col in ((init_guard, "IS_FIRST"), (flush_guard, "IS_LAST")):
+            if col not in bindings.get(var, ""):
+                report.add(
+                    "K2", ERROR, site,
+                    f"guard variable '{var}' is not bound from the plan's "
+                    f"{col} column",
+                )
+
+        blocks = _when_blocks(fn)
+        init_blocks = [
+            (conds, node) for conds, node in blocks
+            if (init_guard, "1") in conds
+        ]
+        flush_blocks = [
+            (conds, node) for conds, node in blocks
+            if (flush_guard, "1") in conds
+        ]
+
+        if group:
+            var, count = group["var"], group["count"]
+            for conds, _node in init_blocks:
+                if (var, "0") not in conds:
+                    report.add(
+                        "K2", ERROR, site,
+                        f"init guard lacks the inner-revisit qualifier "
+                        f"({var} == 0): the grid revisits this tile "
+                        f"across '{var}', so a bare {init_guard} re-zeros "
+                        f"a live accumulator",
+                    )
+            for conds, _node in flush_blocks:
+                if (var, f"{count} - 1") not in conds:
+                    report.add(
+                        "K2", ERROR, site,
+                        f"flush guard lacks the inner-revisit qualifier "
+                        f"({var} == {count} - 1): the output would be "
+                        f"written {count} times per tile run",
+                    )
+
+        # every scratch accumulator zero-initialized inside an init block
+        scratch = tuple(decl["scratch"])
+        initialized: set[str] = set()
+        init_fns = {"zeros_like", "full_like", "zeros", "full"}
+        for _conds, node in init_blocks:
+            for name, assigns in _subscript_stores(node, scratch).items():
+                for a in assigns:
+                    val = getattr(a, "value", None)
+                    if (
+                        isinstance(a, ast.Assign)
+                        and isinstance(val, ast.Call)
+                        and _callee_name(val.func) in init_fns
+                    ):
+                        initialized.add(name)
+        for name in scratch:
+            if name not in initialized:
+                report.add(
+                    "K2", ERROR, site,
+                    f"scratch accumulator '{name}' is never zero-"
+                    f"initialized under the {init_guard} guard — first "
+                    f"grid step reads stale VMEM",
+                )
+
+        # outputs: stored exactly once, only under the flush guard
+        outputs = tuple(decl["outputs"])
+        flush_assigns: dict[str, int] = {n: 0 for n in outputs}
+        flush_nodes: set[int] = set()
+        for _conds, node in flush_blocks:
+            for name, assigns in _subscript_stores(node, outputs).items():
+                flush_assigns[name] += len(assigns)
+                flush_nodes.update(id(a) for a in assigns)
+        all_assigns = _subscript_stores(fn, outputs)
+        for name in outputs:
+            stray = [
+                a for a in all_assigns[name] if id(a) not in flush_nodes
+            ]
+            if stray:
+                report.add(
+                    "K2", ERROR, site,
+                    f"output '{name}' is stored outside the {flush_guard} "
+                    f"flush guard (line {stray[0].lineno}) — partial "
+                    f"accumulation would be written",
+                )
+            if flush_assigns[name] == 0:
+                report.add(
+                    "K2", ERROR, site,
+                    f"output '{name}' is never flushed under the "
+                    f"{flush_guard} guard",
+                )
+            elif flush_assigns[name] > 1:
+                report.add(
+                    "K2", ERROR, site,
+                    f"output '{name}' is flushed {flush_assigns[name]} "
+                    f"times — the contract requires exactly one flush",
+                )
+
+        # K4 source half: every MXU contraction accumulates in f32
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and _callee_name(node.func) == "dot_general"
+            ):
+                kw = {k.arg: k.value for k in node.keywords}
+                pet = kw.get("preferred_element_type")
+                if pet is None or not ast.unparse(pet).endswith("float32"):
+                    report.add(
+                        "K4", ERROR, f"{site}:{node.lineno}",
+                        "dot_general without "
+                        "preferred_element_type=jnp.float32 — MXU "
+                        "accumulation falls back to the input dtype",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# K5 — cache-key soundness
+# ---------------------------------------------------------------------------
+
+_ENV_KEY_RE = "MAGI_ATTENTION_"
+
+
+def _env_getter_keys(env_dir: Path) -> dict[str, set[str]]:
+    """getter function name -> env keys it reads, from env/*.py ASTs."""
+    getters: dict[str, set[str]] = {}
+    for path in sorted(env_dir.glob("*.py")):
+        tree = ast.parse(path.read_text())
+        for fn in ast.walk(tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            keys = {
+                node.value
+                for node in ast.walk(fn)
+                if isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith(_ENV_KEY_RE)
+            }
+            if keys:
+                getters.setdefault(fn.name, set()).update(keys)
+    return getters
+
+
+def consumed_env_keys(
+    kernels_dir: Path | None = None, env_dir: Path | None = None
+) -> dict[str, set[str]]:
+    """env key -> the kernels/ files consuming it (directly via a MAGI_*
+    literal or through an env/ getter call)."""
+    kroot = Path(kernels_dir) if kernels_dir else _kernels_dir()
+    eroot = Path(env_dir) if env_dir else kroot.parent / "env"
+    getters = _env_getter_keys(eroot)
+    consumed: dict[str, set[str]] = {}
+    for path in sorted(kroot.glob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee_name(node.func)
+            for key in getters.get(callee, ()):
+                consumed.setdefault(key, set()).add(path.name)
+            for arg in node.args[:1]:
+                if (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith(_ENV_KEY_RE)
+                ):
+                    consumed.setdefault(arg.value, set()).add(path.name)
+    return consumed
+
+
+def check_env_keys(
+    report: VerifyReport,
+    consumed: dict[str, set[str]] | None = None,
+    listed: tuple[str, ...] | None = None,
+    allowlist: dict[str, str] | None = None,
+) -> None:
+    """K5: every env key that can change kernel lowering (= consumed under
+    kernels/) must invalidate runtime caches via
+    ENV_KEYS_AFFECTING_RUNTIME, unless allowlisted with a proof."""
+    report.mark_run("K5")
+    if consumed is None:
+        consumed = consumed_env_keys()
+    if listed is None:
+        from ..env.general import ENV_KEYS_AFFECTING_RUNTIME
+
+        listed = ENV_KEYS_AFFECTING_RUNTIME
+    if allowlist is None:
+        allowlist = K5_ALLOWLIST
+    for key in sorted(consumed):
+        if key in listed or key in allowlist:
+            continue
+        files = ", ".join(sorted(consumed[key]))
+        report.add(
+            "K5", ERROR, f"kernels/ ({files})",
+            f"env key {key} changes kernel behavior but is missing from "
+            f"ENV_KEYS_AFFECTING_RUNTIME — cached runtimes would be "
+            f"shared across flag flips",
+        )
+
+
+# ---------------------------------------------------------------------------
+# golden corpus + full audit
+# ---------------------------------------------------------------------------
+
+_SEQ = 1024
+
+
+def _canonical_masks(seq: int = _SEQ) -> dict[str, tuple]:
+    """Small self-contained mask set spanning the plan-shape classes the
+    scripts/verify_plans.py corpus uses (dense, causal, varlen, sliding
+    window, block-sparse). Returns name -> (qr, kr, d_lo, d_hi)."""
+    from ..kernels.mask_utils import types_to_bands
+
+    def bands(qr, kr, tm):
+        qr = np.asarray(qr, dtype=np.int32)
+        kr = np.asarray(kr, dtype=np.int32)
+        tm = np.asarray(tm, dtype=np.int32)
+        lo, hi = types_to_bands(qr, kr, tm)
+        return qr, kr, lo, hi
+
+    h = seq // 2
+    quarter = seq // 4
+    masks = {
+        "full": bands([[0, seq]], [[0, seq]], [0]),
+        "causal": bands([[0, seq]], [[0, seq]], [1]),
+        "varlen_block_causal": bands(
+            [[0, quarter], [quarter, h], [h, seq]],
+            [[0, quarter], [quarter, h], [h, seq]],
+            [1, 1, 1],
+        ),
+        "sliding_window": (
+            np.asarray([[0, seq]], dtype=np.int32),
+            np.asarray([[0, seq]], dtype=np.int32),
+            np.asarray([-256], dtype=np.int32),
+            np.asarray([0], dtype=np.int32),
+        ),
+        "block_sparse": bands(
+            [[0, quarter], [h, h + quarter]],
+            [[quarter, h], [0, quarter]],
+            [0, 0],
+        ),
+    }
+    return masks
+
+
+def _largest_reachable_blocks(seq: int, itemsize: int) -> tuple[int, int]:
+    """Max-area tiling reachable for EVERY pass at this dtype — the fwd
+    blocks serve dq/dkv whenever no override is active, so the audit's
+    'largest' sample must sit in the intersection of the per-pass
+    reachable spaces (e.g. (1024, 1024) fits the fwd budget at fp32 but
+    busts the dkv kernel's VMEM, so the policy never emits it for dkv)."""
+    from ..kernels import tile_policy
+
+    spaces = [
+        set(tile_policy.reachable_block_space(seq, seq, kind, 128, 128, itemsize))
+        for kind in ("fwd", "dq", "dkv")
+    ]
+    common = set.intersection(*spaces)
+    return max(common, key=lambda p: (p[0] * p[1], p))
+
+
+def golden_corpus(seq: int = _SEQ) -> list[AuditSpec]:
+    """mask kinds x block sizes x dtypes x GQA group — the sampled config
+    corpus the audit captures real contracts at (the abstract
+    :func:`check_reachable_space` sweep covers the rest of the space)."""
+    specs: list[AuditSpec] = []
+    masks = _canonical_masks(seq)
+    for mask_name, (qr, kr, lo, hi) in masks.items():
+        for dtype in ("bfloat16", "float32"):
+            itemsize = 2 if dtype == "bfloat16" else 4
+            block_choices = dict.fromkeys(
+                ((256, 512), (128, 128),
+                 _largest_reachable_blocks(seq, itemsize))
+            )
+            for g in (1, 2, 4):
+                hk = 2
+                hq = hk * g
+                for blocks in block_choices:
+                    specs.append(
+                        AuditSpec(
+                            name=(
+                                f"{mask_name}/{dtype}/g{g}/"
+                                f"b{blocks[0]}x{blocks[1]}"
+                            ),
+                            q_ranges=qr, k_ranges=kr, d_lo=lo, d_hi=hi,
+                            sq=seq, sk=seq, hq=hq, hk=hk, blocks=blocks,
+                            dtype=dtype,
+                        )
+                    )
+    # coverage riders: max-logits output, and bwd block overrides
+    qr, kr, lo, hi = masks["causal"]
+    specs.append(
+        AuditSpec(
+            name="causal/bfloat16/g1/b256x512/emit_ml",
+            q_ranges=qr, k_ranges=kr, d_lo=lo, d_hi=hi,
+            sq=seq, sk=seq, hq=2, hk=2, blocks=(256, 512), emit_ml=True,
+        )
+    )
+    specs.append(
+        AuditSpec(
+            name="causal/bfloat16/g4/b256x512/bwd_overrides",
+            q_ranges=qr, k_ranges=kr, d_lo=lo, d_hi=hi,
+            sq=seq, sk=seq, hq=8, hk=2, blocks=(256, 512),
+            dq_blocks=(128, 512), dkv_blocks=(256, 256),
+        )
+    )
+    # ragged seqlen: tiles overhang the true extent, so K3 must prove the
+    # maps stay inside the PADDED operands and the padding columns of the
+    # audit report are non-trivially exercised
+    ragged = seq - seq // 8
+    qr, kr, lo, hi = _canonical_masks(ragged)["causal"]
+    specs.append(
+        AuditSpec(
+            name="causal_ragged/bfloat16/g2/b256x512",
+            q_ranges=qr, k_ranges=kr, d_lo=lo, d_hi=hi,
+            sq=ragged, sk=ragged, hq=4, hk=2, blocks=(256, 512),
+        )
+    )
+    return specs
+
+
+def run_kernel_audit(
+    corpus: list[AuditSpec] | None = None,
+    report: VerifyReport | None = None,
+) -> tuple[VerifyReport, list[dict]]:
+    """The full K1-K5 audit: discovery completeness, per-config contract
+    capture + checks, source-level K2/K4, repo-level K5, and the abstract
+    reachable-space K1 sweep. Returns (report, per-config rows)."""
+    report = report or VerifyReport()
+    corpus = corpus if corpus is not None else golden_corpus()
+
+    sites = discover_pallas_sites()
+    declared = _pallas_contracts()
+    for site in sites:
+        if site.kernel_name not in declared:
+            report.add(
+                "K2", ERROR, f"{site.relpath}:{site.line}",
+                f"pallas_call site (kernel '{site.kernel_name}', wrapper "
+                f"'{site.wrapper}') has no PALLAS_CONTRACTS entry — "
+                f"annotate it so K2/K4 can check it",
+            )
+
+    check_kernel_sources(report)
+    check_env_keys(report)
+
+    rows: list[dict] = []
+    captured_kernels: set[str] = set()
+    for spec in corpus:
+        for contract in capture_ffa_contracts(spec):
+            captured_kernels.add(contract.kernel_name)
+            site = f"{spec.name}:{contract.kernel_name}"
+            check_contract(report, contract, site)
+            info = _contract_shape_info(contract)
+            row = {
+                "config": spec.name,
+                "kernel": contract.kernel_name,
+                "grid": list(contract.grid),
+                "vmem_bytes": _declared_bytes(contract),
+                "vmem_total_bytes": ffa_kernel_residency(
+                    info["kind"], info["bq"], info["bk"], info["d"],
+                    head_dim_v=info["dv"], dtype_bytes=info["itemsize"],
+                    group=info["g"], packed=info["packed"],
+                    emit_ml=info["emit_ml"],
+                ),
+                "vmem_allowed_bytes": VMEM_ALLOWED_BYTES,
+            }
+            row.update(padding_stats(contract, spec.sq, spec.sk))
+            rows.append(row)
+
+    site_kernels = {
+        s.kernel_name for s in sites if s.kernel_name in declared
+    }
+    for missing in sorted(site_kernels - captured_kernels):
+        report.add(
+            "K1", ERROR, f"kernels/:{missing}",
+            f"kernel '{missing}' has a pallas_call site but no corpus "
+            f"config exercised it — the audit is not complete",
+        )
+
+    sweep = check_reachable_space(report, _SEQ, _SEQ)
+    rows.append({"config": "reachable_space_sweep", **sweep})
+    return report, rows
+
+
+# ---------------------------------------------------------------------------
+# seeded mutations — the checker's own regression proof
+# ---------------------------------------------------------------------------
+
+# a minimal clean kernel in the house style; the K2 mutation deletes its
+# init block. Kept source-level so the mutation exercises the same AST
+# path as the real kernels.
+_TOY_KERNEL_SRC = '''
+def _toy_kernel(qt_ref, kt_ref, meta_ref, x_ref, o_ref, acc_scr):
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+
+    @pl.when(is_first == 1)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += jax.lax.dot_general(
+        x_ref[:], x_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(is_last == 1)
+    def _():
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
+'''
+
+_TOY_CONTRACTS = {
+    "_toy_kernel": dict(
+        wrapper="_toy",
+        scratch=("acc_scr",),
+        outputs=("o_ref",),
+        out_dtypes=("input",),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=None,
+    ),
+}
+
+
+def _mutation_spec() -> AuditSpec:
+    # hq (8) > num_q_tiles (4) so the swapped-axes mutation is provably
+    # out of bounds on the q-tile axis
+    qr, kr, lo, hi = _canonical_masks(512)["causal"]
+    return AuditSpec(
+        name="mutation/causal", q_ranges=qr, k_ranges=kr, d_lo=lo, d_hi=hi,
+        sq=512, sk=512, hq=8, hk=8, blocks=(128, 128),
+    )
+
+
+def run_seeded_mutations() -> list[dict]:
+    """Apply each seeded defect to a clean contract/source/key-set and
+    report which rules fire. A healthy checker fires EXACTLY the expected
+    rule per mutation — the test suite and ``kernel_audit --selftest``
+    both assert on this."""
+    from types import SimpleNamespace
+
+    base = next(
+        c for c in capture_ffa_contracts(_mutation_spec())
+        if c.kernel_name == "_fwd_kernel"
+    )
+    results: list[dict] = []
+
+    def run(name: str, expected: str, check) -> None:
+        report = VerifyReport()
+        check(report)
+        fired = report.fired_rules()
+        results.append(
+            {
+                "mutation": name,
+                "expected_rule": expected,
+                "fired_rules": sorted(fired),
+                "ok": fired == {expected},
+            }
+        )
+
+    def oversized(report: VerifyReport) -> None:
+        mut = replace(
+            base,
+            scratch=tuple(
+                ((shape[0] * 64,) + tuple(shape[1:]), dtype)
+                for shape, dtype in base.scratch
+            ),
+        )
+        check_contract(report, mut, "mutation:oversized_scratch")
+
+    def swapped(report: VerifyReport) -> None:
+        q_spec = base.in_specs[0]
+        orig = q_spec.index_map
+        shim = SimpleNamespace(
+            block_shape=q_spec.block_shape,
+            # swap the head and q-tile outputs of the real map
+            index_map=lambda *a: (
+                lambda o: (o[1], o[0]) + tuple(o[2:])
+            )(orig(*a)),
+        )
+        mut = replace(base, in_specs=(shim,) + tuple(base.in_specs[1:]))
+        check_contract(report, mut, "mutation:swapped_index_map")
+
+    def no_init(report: VerifyReport) -> None:
+        src = _TOY_KERNEL_SRC
+        start = src.index("    @pl.when(is_first == 1)")
+        end = src.index("    acc_scr[:] +=")
+        check_kernel_sources(
+            report, src[:start] + src[end:], _TOY_CONTRACTS, "mutation.py"
+        )
+
+    def bf16_scratch(report: VerifyReport) -> None:
+        mut = replace(
+            base,
+            scratch=tuple(
+                (shape, "bfloat16") for shape, _ in base.scratch
+            ),
+        )
+        check_contract(report, mut, "mutation:bf16_scratch")
+
+    def unlisted_key(report: VerifyReport) -> None:
+        check_env_keys(
+            report,
+            consumed={"MAGI_ATTENTION_UNLISTED_KNOB": {"ffa.py"}},
+        )
+
+    run("oversized_scratch", "K1", oversized)
+    run("swapped_index_map_axes", "K3", swapped)
+    run("missing_accumulator_init", "K2", no_init)
+    run("bf16_accumulator", "K4", bf16_scratch)
+    run("unlisted_env_key", "K5", unlisted_key)
+    return results

@@ -20,6 +20,10 @@ Rules (MAGI-L prefix; all stdlib ``ast``, no third-party linter deps):
   (``resilience.inject.INJECTION_SITES``) is exercised somewhere in
   ``tests/test_resilience/``: a site nobody injects is a recovery path
   nobody tests, which is how fallback code rots.
+- **MAGI-L006** — every ``MAGI_*`` env key named under ``env/`` has a
+  row in ``docs/env_variables.md``: an undocumented flag is invisible to
+  operators, and the doc table doubles as the review surface for the
+  "does this key belong in ENV_KEYS_AFFECTING_RUNTIME?" decision.
 
 Known-legacy findings live in ``lint_baseline.txt`` (``<rule> <relpath>``
 per line) so the linter lands green and only *new* violations fail CI.
@@ -249,6 +253,67 @@ def check_injection_site_coverage(root: str) -> list[LintFinding]:
     return findings
 
 
+_ENV_KEY_RE = None  # compiled lazily; keeps the module import light
+
+
+def check_env_doc_coverage(
+    root: str, docs_path: str | None = None
+) -> list[LintFinding]:
+    """MAGI-L006: every ``MAGI_*`` env key string constant under ``env/``
+    appears in ``docs/env_variables.md``.
+
+    Keys are discovered syntactically (string constants matching
+    ``MAGI_[A-Z0-9_]+`` in ``env/*.py``) so getters, the
+    ``ENV_KEYS_AFFECTING_RUNTIME`` registry, and scoped_env defaults all
+    feed the same check. Non-``MAGI_`` keys (e.g. the upstream
+    ``JAX_COMPILATION_CACHE_DIR`` passthrough) are deliberately exempt —
+    they are not ours to catalogue.
+    """
+    global _ENV_KEY_RE
+    if _ENV_KEY_RE is None:
+        import re
+
+        _ENV_KEY_RE = re.compile(r"^MAGI_[A-Z0-9_]+$")
+    findings: list[LintFinding] = []
+    env_dir = os.path.join(root, "env")
+    if not os.path.isdir(env_dir):
+        return findings
+    if docs_path is None:
+        docs_path = os.path.join(
+            os.path.dirname(root), "docs", "env_variables.md"
+        )
+    doc_text = ""
+    if os.path.exists(docs_path):
+        with open(docs_path, "r", encoding="utf-8") as f:
+            doc_text = f.read()
+    for path in _iter_py_files(env_dir):
+        relpath = os.path.relpath(path, root)
+        with open(path, "r", encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+        seen: set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _ENV_KEY_RE.match(node.value)
+                and node.value not in seen
+                and node.value not in doc_text
+            ):
+                seen.add(node.value)
+                findings.append(
+                    LintFinding(
+                        "MAGI-L006", relpath, node.lineno,
+                        f"env key {node.value} has no row in "
+                        "docs/env_variables.md — document it (and decide "
+                        "whether it belongs in ENV_KEYS_AFFECTING_RUNTIME)",
+                    )
+                )
+    return findings
+
+
 def lint_package(root: str) -> list[LintFinding]:
     """Run every rule over a package directory; findings in path order."""
     findings: list[LintFinding] = []
@@ -256,6 +321,7 @@ def lint_package(root: str) -> list[LintFinding]:
         findings.extend(lint_file(path, os.path.relpath(path, root)))
     findings.extend(check_rule_coverage(root))
     findings.extend(check_injection_site_coverage(root))
+    findings.extend(check_env_doc_coverage(root))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 
@@ -285,6 +351,12 @@ def run(root: str, baseline_path: str | None = None) -> int:
     for key in stale:
         w(f"note: stale baseline entry (violation fixed — remove the "
           f"line): {key}\n")
+    if baseline:
+        w(
+            f"warning: lint baseline is non-empty ({len(baseline)} "
+            f"entr{'y' if len(baseline) == 1 else 'ies'}) — the legacy "
+            f"debt was burned down; fix the site instead of baselining\n"
+        )
     w(
         f"lint: {len(findings)} finding(s), {len(findings) - len(fresh)} "
         f"baselined, {len(fresh)} new\n"

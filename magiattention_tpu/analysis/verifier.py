@@ -529,8 +529,15 @@ def check_tiles(
 ) -> None:
     """R5: chosen (block_q, block_k) respect the TPU lane quanta, bwd
     overrides divide the fwd-padded geometry, and every pass's resident
-    blocks fit the VMEM budget declared in kernels/tile_policy."""
-    from ..kernels.tile_policy import VMEM_BUDGET, _bwd_vmem_bytes, _vmem_bytes
+    blocks fit the VMEM budget. The byte model is the kernel checker's
+    (analysis/kernel_check, rule K1) — the same arithmetic that is proven
+    against the captured pallas_call contracts, so R5 and K1 cannot
+    disagree about what fits."""
+    from .kernel_check import (
+        POLICY_VMEM_BUDGET as VMEM_BUDGET,
+        bwd_vmem_bytes as _bwd_vmem_bytes,
+        fwd_vmem_bytes as _vmem_bytes,
+    )
 
     report.mark_run("R5")
     bq, bk = fwd_blocks

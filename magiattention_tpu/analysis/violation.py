@@ -27,6 +27,23 @@ RULES: dict[str, str] = {
           "overlap degree consistent between CommMeta and CalcMeta",
     "R5": "tile legality: chosen blocks respect TPU alignment, divide the "
           "fwd-padded geometry (bwd overrides) and fit the VMEM budget",
+    # Kernel contract rules (analysis/kernel_check.py; catalogue with
+    # examples in docs/kernel_contracts.md).
+    "K1": "kernel VMEM budget: sum of BlockSpec + scratch footprints fits "
+          "the per-step budget with headroom, and the shared mem_budget "
+          "estimator upper-bounds the exact residency",
+    "K2": "accumulator discipline: every cross-step scratch accumulator is "
+          "zero-initialized under the is-first guard (innermost-position "
+          "qualified when the grid revisits tiles) and flushed exactly "
+          "once under the is-last guard",
+    "K3": "index-map bounds: every index_map output x block shape stays "
+          "inside its operand for all grid points",
+    "K4": "dtype/precision: f32 accumulator scratch, f32-preferred "
+          "dot_generals, no implicit f32->bf16 truncation before the "
+          "final guarded write",
+    "K5": "cache-key soundness: every env key consumed under kernels/ "
+          "appears in ENV_KEYS_AFFECTING_RUNTIME (or the audited "
+          "no-lowering-effect allowlist)",
 }
 
 # Which verifier rule(s) cover each public dataclass in meta/collection.

@@ -9,12 +9,21 @@ amortized over ``rep`` launches).
 
 from __future__ import annotations
 
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
 import jax
 import numpy as np
+
+
+def _echo(msg: str) -> None:
+    """Benchmark-table output channel. The harness's tables and timing
+    lines ARE its product (chip-window logs consume them), so they must
+    not be gated behind MAGI_ATTENTION_LOG_LEVEL like library logging."""
+    sys.stdout.write(msg + "\n")
+    sys.stdout.flush()
 
 
 def do_bench(
@@ -177,11 +186,10 @@ def do_bench_scan_slope(
             f" -> NOISE GUARD: fallback to len{long_} upper bound "
             f"{t_long_best:.3f}"
         )
-        print(
+        _echo(
             f"  [slope timing incl compile {time.perf_counter()-t0:.0f}s: "
             f"per-rep slopes {[round(s, 3) for s in slopes]} ms/step"
-            + guard,
-            flush=True,
+            + guard
         )
     # noise guard: non-positive slope (long ran FASTER than short) or slope
     # above the long-scan per-step time (negative implied overhead) means
@@ -197,7 +205,7 @@ def do_bench_scan_verbose(body, carry0, length=8, reps=3):
     scripts want compile time visible in their logs)."""
     t0 = time.perf_counter()
     ms = do_bench_scan(body, carry0, length=length, reps=reps)
-    print(f"  [total incl compile {time.perf_counter()-t0:.0f}s]", flush=True)
+    _echo(f"  [total incl compile {time.perf_counter()-t0:.0f}s]")
     return ms
 
 
@@ -321,9 +329,9 @@ def _print_table(rows: list[dict]) -> None:
         return
     keys = list(rows[0].keys())
     widths = [max(len(str(k)), 12) for k in keys]
-    print("  ".join(str(k).ljust(w) for k, w in zip(keys, widths)))
+    _echo("  ".join(str(k).ljust(w) for k, w in zip(keys, widths)))
     for row in rows:
-        print(
+        _echo(
             "  ".join(
                 (f"{row.get(k, ''):.2f}" if isinstance(row.get(k), float)
                  else str(row.get(k, ""))).ljust(w)

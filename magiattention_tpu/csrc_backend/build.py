@@ -23,11 +23,9 @@ _LIB_ERR: ImportError | None = None  # memoized failure: never retry builds
 
 
 def _cache_dir() -> Path:
-    base = os.environ.get(
-        "MAGI_ATTENTION_JIT_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "magiattention_tpu"),
-    )
-    return Path(base)
+    from ..env.general import jit_cache_dir
+
+    return Path(jit_cache_dir())
 
 
 def _build(src: Path, out: Path) -> None:

@@ -97,6 +97,49 @@ def is_interpret_mode_enable() -> bool:
     return _get_bool("MAGI_ATTENTION_PALLAS_INTERPRET")
 
 
+def jit_cache_dir() -> str:
+    """On-disk cache for the native (C) host backend's build artifacts
+    (csrc_backend/build.py)."""
+    return _get_str(
+        "MAGI_ATTENTION_JIT_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "magiattention_tpu"),
+    )
+
+
+def jax_compilation_cache_dir() -> str:
+    """JAX persistent compilation cache directory (utils/compile_cache.py);
+    empty = caller's default. Not a MAGI_ key — it is JAX's own knob,
+    surfaced here so key ownership stays in env/."""
+    return _get_str("JAX_COMPILATION_CACHE_DIR", "")
+
+
+class scoped_env:
+    """Temporarily set/del environment variables, restoring on exit — the
+    ONE sanctioned ``os.environ`` mutation point outside process startup
+    (lint rule MAGI-L001 allows env/ only). Values of ``None`` unset the
+    key. Used by testing/flag_generator.with_flags and test fixtures."""
+
+    def __init__(self, overrides: dict[str, str | None]) -> None:
+        self._overrides = dict(overrides)
+        self._saved: dict[str, str | None] = {}
+
+    def __enter__(self) -> "scoped_env":
+        for key, val in self._overrides.items():
+            self._saved[key] = os.environ.get(key)
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = str(val)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for key, old in self._saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
 # flags that change numerics / planning output and therefore must be part of
 # every runtime cache key (ref: env/ffa.py:125 ENV_KEYS_AFFECTING_COMPILATION)
 ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
@@ -119,6 +162,7 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     "MAGI_ATTENTION_FFA_BLOCK_K_DKV",
     "MAGI_ATTENTION_FFA_GQA_PACK",
     "MAGI_ATTENTION_FFA_GQA_PACK_DQ",
+    "MAGI_ATTENTION_FFA_GQA_PACK_DKV",
     "MAGI_ATTENTION_FFA_AUTO_TILE",
     # wire-tier selection changes the traced collective program
     "MAGI_ATTENTION_RAGGED_GRPCOLL",

@@ -55,6 +55,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ..env import general as env_general
 from ..env import kernel as env_kernel
 from ..resilience.inject import maybe_inject
+from ..utils.mem_budget import VMEM_ALLOWED_BYTES, ffa_kernel_residency
 from .ffa_plan import (  # noqa: F401
     IS_FULL,
     DHI,
@@ -584,17 +585,24 @@ def _ffa_fwd_pallas_gqa(
     return out_t, lse_t, ml
 
 
-def _use_gqa_pack(params: FFAParams) -> bool:
+def _use_gqa_pack(
+    params: FFAParams, d: int, dv: int, itemsize: int = 2
+) -> bool:
     """Trace-time dispatch to the packed fwd kernel: opt-in flag, real
     grouping, no max-logits (the packed kernel doesn't emit them), and a
-    VMEM guard — the packed (g*bq, bk) fp32 score tile must stay well
-    under the ~16 MB VMEM budget."""
+    VMEM guard — the EXACT packed-step residency (blocks + scratch +
+    score-tile intermediates, utils/mem_budget.ffa_kernel_residency — the
+    same model the static kernel checker proves K1 with) must fit the
+    per-core budget with headroom."""
     return (
         env_kernel.ffa_gqa_pack()
         and params.group > 1
         and not params.emit_max_logits
-        and params.group * params.block_q * params.block_k * 4
-        <= 8 * 1024 * 1024
+        and ffa_kernel_residency(
+            "fwd", params.block_q, params.block_k, d, head_dim_v=dv,
+            dtype_bytes=itemsize, group=params.group, packed=True,
+        )
+        <= VMEM_ALLOWED_BYTES
     )
 
 
@@ -931,17 +939,23 @@ def _ffa_bwd_dq_pallas_gqa(
     return dq_g.reshape(hq, sqp, d) * params.softmax_scale
 
 
-def _use_gqa_pack_dq(params: FFAParams, d: int) -> bool:
+def _use_gqa_pack_dq(
+    params: FFAParams, d: int, dv: int | None = None, itemsize: int = 2
+) -> bool:
     """Trace-time dispatch to the packed dq kernel: opt-in flag, real
-    grouping, VMEM guard on the packed (g*bq, bk) fp32 score tile +
-    (g*bq, 2*d) fp32 scratch (dq accumulator + dp tile) with the REAL
-    head_dim — a hardcoded 256 underestimated residency at d > 256
-    (r3 advisor finding)."""
+    grouping, and a VMEM guard on the EXACT packed-step residency with the
+    REAL head dims (utils/mem_budget.ffa_kernel_residency — shared with
+    the static kernel checker's K1; an earlier score-tile-only formula
+    under-counted blocks + scratch at large head_dim)."""
     bq, bk = params.dq_blocks()
     return (
         env_kernel.ffa_gqa_pack_dq()
         and params.group > 1
-        and params.group * bq * (bk + 2 * d) * 4 <= 8 * 1024 * 1024
+        and ffa_kernel_residency(
+            "dq", bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize,
+            group=params.group, packed=True,
+        )
+        <= VMEM_ALLOWED_BYTES
     )
 
 
@@ -954,7 +968,9 @@ def ffa_bwd_dq_pallas_dispatch(
     uses so the packed dq kernel is reachable from all of them (mirrors
     :func:`ffa_fwd_pallas_dispatch`)."""
     fn = (
-        _ffa_bwd_dq_pallas_gqa if _use_gqa_pack_dq(params, q_t.shape[2])
+        _ffa_bwd_dq_pallas_gqa
+        if _use_gqa_pack_dq(params, q_t.shape[2], v_t.shape[2],
+                            q_t.dtype.itemsize)
         else _ffa_bwd_dq_pallas
     )
     return fn(params, work_qt, work_kt, meta, q_t, k_t, v_t, do_t, lse_t,
@@ -1356,20 +1372,26 @@ def _ffa_bwd_dkv_pallas_gqa(
     return dk_t, dv_t
 
 
-def _use_gqa_pack_dkv(params: FFAParams, sqp: int, d: int, dv: int) -> bool:
+def _use_gqa_pack_dkv(
+    params: FFAParams, sqp: int, d: int, dv: int, itemsize: int = 2
+) -> bool:
     """Trace-time dispatch to the packed dkv kernel. ON by default when
     there is real grouping (env flag ``ffa_gqa_pack_dkv``) and shapes
     divide (the dkv q tile must tile the padded seqlen for the host-side
-    lse/delta tile-pack). VMEM guard: the packed (bk, g*bq) fp32
-    s_t + dp_t tiles plus the (bk, d+dv) fp32 dk/dv scratch must stay
-    well under the ~16 MB budget."""
+    lse/delta tile-pack). VMEM guard: the EXACT packed-step residency —
+    blocks + (bk, d+dv) fp32 scratch + the (bk, g*bq) fp32 s_t/dp_t tiles
+    (utils/mem_budget.ffa_kernel_residency, shared with the static kernel
+    checker's K1) — must fit the per-core budget with headroom."""
     bq, bk = params.dkv_blocks()
     return (
         env_kernel.ffa_gqa_pack_dkv()
         and params.group > 1
         and sqp % bq == 0
-        and (2 * params.group * bq * bk + bk * (d + dv)) * 4
-        <= 8 * 1024 * 1024
+        and ffa_kernel_residency(
+            "dkv", bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize,
+            group=params.group, packed=True,
+        )
+        <= VMEM_ALLOWED_BYTES
     )
 
 
@@ -1384,11 +1406,84 @@ def ffa_bwd_dkv_pallas_dispatch(
     fn = (
         _ffa_bwd_dkv_pallas_gqa
         if _use_gqa_pack_dkv(params, q_t.shape[1], q_t.shape[2],
-                             v_t.shape[2])
+                             v_t.shape[2], q_t.dtype.itemsize)
         else _ffa_bwd_dkv_pallas
     )
     return fn(params, work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
               lse_t, delta_t)
+
+
+# ---------------------------------------------------------------------------
+# static kernel contracts (consumed by analysis/kernel_check.py)
+# ---------------------------------------------------------------------------
+
+# One entry per Pallas kernel body in this file; the static checker's K2
+# (accumulator discipline) and K4 (precision) passes read these as ground
+# truth and verify the kernel SOURCE against them, so a drive-by edit that
+# drops an init or moves a flush out of its guard fails `make kernel-audit`.
+# Names refer to ref parameters / unpacked locals inside the kernel body.
+# ``group_inner`` marks kernels whose grid revisits the same output tile
+# across an inner grid dimension: init/flush must then additionally be
+# qualified on that dimension's first/last position — the dkv-GQA-pack bug
+# class K2 exists for. ``out_dtypes`` pairs positionally with the
+# pallas_call out_shape ("input" = operand dtype passthrough, "f32" =
+# must be float32); trailing optional outputs may be absent at capture.
+PALLAS_CONTRACTS: dict[str, dict] = {
+    "_fwd_kernel": dict(
+        wrapper="_ffa_fwd_pallas",
+        scratch=("m_scr", "l_scr", "acc_scr"),
+        outputs=("out_ref", "lse_ref", "ml_ref"),
+        out_dtypes=("input", "f32", "f32"),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=None,
+    ),
+    "_fwd_kernel_gqa": dict(
+        wrapper="_ffa_fwd_pallas_gqa",
+        scratch=("m_scr", "l_scr", "acc_scr"),
+        outputs=("out_ref", "lse_ref"),
+        out_dtypes=("input", "f32"),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=None,
+    ),
+    "_bwd_dq_kernel": dict(
+        wrapper="_ffa_bwd_dq_pallas",
+        scratch=("dq_scr",),
+        outputs=("dq_ref",),
+        out_dtypes=("f32",),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=None,
+    ),
+    "_bwd_dq_kernel_gqa": dict(
+        wrapper="_ffa_bwd_dq_pallas_gqa",
+        scratch=("dq_scr",),
+        outputs=("dq_ref",),
+        out_dtypes=("f32",),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=None,
+    ),
+    "_bwd_dkv_kernel": dict(
+        wrapper="_ffa_bwd_dkv_pallas",
+        scratch=("dk_scr", "dv_scr"),
+        outputs=("dk_ref", "dv_ref"),
+        out_dtypes=("f32", "f32"),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=dict(var="gi", count="group"),
+    ),
+    "_bwd_dkv_kernel_gqa": dict(
+        wrapper="_ffa_bwd_dkv_pallas_gqa",
+        scratch=("dk_scr", "dv_scr"),
+        outputs=("dk_ref", "dv_ref"),
+        out_dtypes=("f32", "f32"),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=None,
+    ),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -1414,7 +1509,12 @@ def ffa_fwd_pallas_dispatch(params: FFAParams, work_qt, work_kt, meta,
     entry every forward path (custom-vjp core, CP multi-stage, sink) uses
     so the packed kernel is reachable from all of them."""
     maybe_inject("kernel_lowering")
-    fwd = _ffa_fwd_pallas_gqa if _use_gqa_pack(params) else _ffa_fwd_pallas
+    fwd = (
+        _ffa_fwd_pallas_gqa
+        if _use_gqa_pack(params, q_t.shape[2], v_t.shape[2],
+                         q_t.dtype.itemsize)
+        else _ffa_fwd_pallas
+    )
     return fwd(params, work_qt, work_kt, meta, q_t, k_t, v_t)
 
 

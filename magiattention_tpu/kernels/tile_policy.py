@@ -233,11 +233,12 @@ def choose_blocks(
 def _bwd_vmem_bytes(
     kind: str, bq: int, bk: int, d: int, dv: int, itemsize: int
 ) -> int:
-    """Per-step VMEM residency of the bwd kernels: the fwd estimator's
-    resident blocks plus the pass's fp32 accumulator scratch and score
-    tile ((bq,bk) for dq, transposed for dkv — same size)."""
-    scratch = bq * d if kind == "dq" else bk * (d + dv)
-    return _vmem_bytes(bq, bk, d, dv, itemsize) + 4 * (scratch + bq * bk)
+    """Per-step VMEM residency of the bwd kernels — ONE estimator for the
+    whole package (utils/mem_budget.ffa_bwd_vmem_budget), shared with the
+    static kernel checker (analysis/kernel_check K1) and verifier R5."""
+    from ..utils.mem_budget import ffa_bwd_vmem_budget
+
+    return ffa_bwd_vmem_budget(kind, bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize)
 
 
 def _band_candidates(
@@ -363,6 +364,62 @@ def choose_blocks_per_pass(
     return choose_blocks_per_pass_multi(
         [(qr, kr, d_lo, d_hi)], sq, sk, d, dv, itemsize
     )
+
+
+def reachable_block_space(
+    sq: int,
+    sk: int,
+    kind: str = "fwd",
+    d: int = 128,
+    dv: int = 128,
+    itemsize: int = 2,
+) -> list[tuple[int, int]]:
+    """Every ``(block_q, block_k)`` this policy can emit for a pass of the
+    given ``kind`` ("fwd" | "dq" | "dkv") at problem size (sq, sk) —
+    the closure the static kernel checker (analysis/kernel_check) proves
+    K1/K3 over, so a tiling the policy can choose is by construction a
+    tiling the checker has audited.
+
+    The space is the union of:
+
+    - the clamped default (``ffa.default_blocks`` fallback, also the
+      score-loop fallback when every candidate busts VMEM),
+    - every VMEM-feasible clamped :data:`CANDIDATES` entry,
+    - the band-derived grid ``{128, 256, 512} x {128, 256, ..., 1024}``
+      (:func:`_band_candidates` emits ``bk_band`` = the narrowest band
+      width rounded to the lane quantum and clamped to [128, 1024] —
+      data-dependent, so the whole reachable range is enumerated).
+
+    Env overrides (MAGI_ATTENTION_FFA_BLOCK_*) are intentionally NOT
+    bounded here: they pass through ``resolve_bwd_overrides``'s
+    divisibility/quantum gate and the kernels' own VMEM dispatch guards,
+    and the audit CLI checks the documented defaults explicitly.
+    """
+    if kind not in ("fwd", "dq", "dkv"):
+        raise ValueError(f"kind must be 'fwd'|'dq'|'dkv', got {kind!r}")
+    cands = set(CANDIDATES)
+    cands.update(
+        (bq, bk_band)
+        for bq in (128, 256, 512)
+        for bk_band in range(NUM_LANES, 1024 + 1, NUM_LANES)
+    )
+    space: set[tuple[int, int]] = set()
+    for bq, bk in cands:
+        bq = min(bq, _round_up(sq, 16))
+        bk = min(bk, _round_up(sk, NUM_LANES))
+        if kind == "fwd":
+            vmem = _vmem_bytes(bq, bk, d, dv, itemsize)
+        else:
+            vmem = _bwd_vmem_bytes(kind, bq, bk, d, dv, itemsize)
+        if vmem > VMEM_BUDGET:
+            continue
+        space.add((bq, bk))
+    # the clamped default is reachable regardless of the VMEM filter
+    # (score-loop fallback + ffa.default_blocks)
+    space.add(
+        (min(256, _round_up(sq, 16)), min(512, _round_up(sk, NUM_LANES)))
+    )
+    return sorted(space)
 
 
 def _round_up(x: int, m: int) -> int:

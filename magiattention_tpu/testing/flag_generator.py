@@ -8,10 +8,11 @@ sequential (one flag varied at a time), random (seeded sampling), heuristic
 
 from __future__ import annotations
 
-import os
 import random
 from contextlib import contextmanager
 from typing import Iterator
+
+from ..env.general import scoped_env
 
 # flag -> candidate values (None = unset)
 FLAG_SPACE: dict[str, list[str | None]] = {
@@ -79,18 +80,7 @@ class FlagCombGenerator:
 
 @contextmanager
 def with_flags(combo: dict[str, str | None]):
-    """Temporarily apply a flag combination to os.environ."""
-    saved = {k: os.environ.get(k) for k in combo}
-    try:
-        for k, v in combo.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+    """Temporarily apply a flag combination via env.general.scoped_env
+    (the one sanctioned environment mutation point)."""
+    with scoped_env(combo):
         yield
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v

@@ -29,7 +29,9 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     """
     import jax
 
-    path = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT_DIR
+    from ..env.general import jax_compilation_cache_dir
+
+    path = cache_dir or jax_compilation_cache_dir() or _DEFAULT_DIR
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # Small nonzero floor: the 20-40s Mosaic kernels this cache exists for

@@ -8,6 +8,16 @@ bound the maximum merged-buffer seqlen for a given budget.
 
 from __future__ import annotations
 
+# Per-core VMEM on current TPU generations (v4/v5e/v5p: 16 MiB), and the
+# margin left for Mosaic's own spills/semaphores/metadata. Every layer that
+# bounds kernel residency — the tile policy's candidate filter, the packed-
+# kernel dispatch guards in kernels/ffa.py, verifier rule R5 and the static
+# kernel checker's K1 — derives its limit from THESE constants, so the
+# budget model cannot diverge between plan-time and kernel-time checks.
+VMEM_LIMIT_BYTES = 16 * 1024 * 1024
+VMEM_HEADROOM_BYTES = 2 * 1024 * 1024
+VMEM_ALLOWED_BYTES = VMEM_LIMIT_BYTES - VMEM_HEADROOM_BYTES
+
 
 def ffa_vmem_budget(
     block_q: int,
@@ -27,6 +37,94 @@ def ffa_vmem_budget(
     ml = 2 * block_q * 128 * 4
     s = block_q * block_k * 4  # logits tile (fp32)
     return 2 * (q + k + v + out) + acc + ml + s
+
+
+def ffa_bwd_vmem_budget(
+    kind: str,
+    block_q: int,
+    block_k: int,
+    head_dim: int,
+    head_dim_v: int | None = None,
+    dtype_bytes: int = 2,
+) -> int:
+    """Approximate bwd-kernel VMEM residency in bytes for one grid step:
+    the fwd residency plus the pass's fp32 accumulator scratch and the
+    recomputed score tile ((bq, bk) for dq, transposed — same size — for
+    dkv). ``kind`` is "dq" or "dkv"."""
+    if kind not in ("dq", "dkv"):
+        raise ValueError(f"kind must be 'dq' or 'dkv', got {kind!r}")
+    dv = head_dim_v or head_dim
+    scratch = block_q * head_dim if kind == "dq" else block_k * (head_dim + dv)
+    return (
+        ffa_vmem_budget(block_q, block_k, head_dim, dv, dtype_bytes)
+        + 4 * (scratch + block_q * block_k)
+    )
+
+
+def ffa_kernel_residency(
+    kind: str,
+    block_q: int,
+    block_k: int,
+    head_dim: int,
+    head_dim_v: int | None = None,
+    dtype_bytes: int = 2,
+    group: int = 1,
+    packed: bool = False,
+    emit_ml: bool = False,
+    include_intermediates: bool = True,
+) -> int:
+    """EXACT declared VMEM residency of one FFA kernel grid step, in bytes.
+
+    Mirrors the BlockSpec/scratch shapes in ``kernels/ffa.py`` closed-form:
+    every in/out block is double-buffered by the Pallas pipeline, scratch is
+    single-buffered, and (when ``include_intermediates``) the fp32 score-
+    sized value tiles Mosaic must materialize are added (one (rows, bk) tile
+    for fwd — p reuses s's storage — and two for the bwd passes: s + dp).
+    The static kernel checker (analysis/kernel_check, rule K1) asserts this
+    function matches the captured pallas_call contracts bit-for-bit, so the
+    dispatch guards below it cannot drift from the real kernels.
+
+    ``packed`` selects the GQA-packed variant (query rows x ``group``);
+    unpacked kernels are per-q-head, so ``group`` is ignored for them
+    except dkv's lse/delta sublane layout which is group-independent.
+    """
+    if kind not in ("fwd", "dq", "dkv"):
+        raise ValueError(f"kind must be 'fwd'|'dq'|'dkv', got {kind!r}")
+    dv = head_dim_v or head_dim
+    g = group if packed else 1
+    bq, bk, d = block_q, block_k, head_dim
+    f32 = 4
+
+    k_in = bk * d * dtype_bytes
+    v_in = bk * dv * dtype_bytes
+    q_in = g * bq * d * dtype_bytes
+    if kind == "fwd":
+        blocks = q_in + k_in + v_in
+        blocks += g * bq * dv * dtype_bytes  # out
+        blocks += g * bq * 128 * f32  # lse (lanes-broadcast)
+        if emit_ml and not packed:
+            blocks += bq * 128 * f32  # max-logits (fwd unpacked only)
+        scratch = (2 * g * bq * 128 + g * bq * dv) * f32  # m, l, acc
+        inter = g * bq * bk * f32  # s (p reuses its storage)
+    elif kind == "dq":
+        blocks = q_in + k_in + v_in
+        blocks += g * bq * dv * dtype_bytes  # do
+        blocks += 2 * (g if packed else 1) * bq * f32  # lse + delta rows
+        blocks += g * bq * d * f32  # dq out (fp32)
+        scratch = g * bq * d * f32
+        inter = 2 * g * bq * bk * f32  # s + dp
+    else:  # dkv
+        blocks = q_in + k_in + v_in
+        blocks += g * bq * dv * dtype_bytes  # do
+        # lse/delta: packed rides (1, g*bq) rows; unpacked an (8, bq) slab
+        blocks += 2 * (g * bq if packed else 8 * bq) * f32
+        blocks += (bk * d + bk * dv) * f32  # dk + dv outs (fp32)
+        scratch = (bk * d + bk * dv) * f32
+        inter = 2 * g * bq * bk * f32  # s_t + dp_t
+    total = 2 * blocks + scratch
+    if include_intermediates:
+        total += inter
+    return total
 
 
 def ffa_max_total_seqlen(

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Static kernel contract audit (analysis/kernel_check, rules K1-K5) over
+the golden config corpus — every pallas_call site under kernels/, checked
+at mask kinds x block sizes x dtypes x GQA group, entirely on CPU and
+without executing a single kernel body. Prints a per-kernel VMEM/padding
+report and exits non-zero on ANY violation (no waiver mechanism exists for
+K rules by design). This is the third leg of ``make analysis`` next to the
+AST linter and the plan verifier.
+
+``--selftest`` runs the seeded-mutation harness instead: five planted
+defects (oversized scratch, swapped index_map axes, missing accumulator
+init, bf16 accumulator, unlisted env key) must each fire EXACTLY their
+expected K rule, proving the checker itself detects what it claims to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from magiattention_tpu import telemetry  # noqa: E402
+from magiattention_tpu.analysis import kernel_check  # noqa: E402
+
+
+def _fmt_mib(n: int) -> str:
+    return f"{n / (1024 * 1024):.2f} MiB"
+
+
+def _per_kernel_report(rows: list[dict]) -> list[str]:
+    by_kernel: dict[str, list[dict]] = {}
+    for row in rows:
+        if "kernel" in row:
+            by_kernel.setdefault(row["kernel"], []).append(row)
+    lines = ["per-kernel VMEM / padding (worst config per kernel):"]
+    for kernel in sorted(by_kernel):
+        krows = by_kernel[kernel]
+        worst = max(krows, key=lambda r: r["vmem_total_bytes"])
+        pad = max(
+            (r.get("padded_ratio", 0.0) for r in krows), default=0.0
+        )
+        lines.append(
+            f"  {kernel:22s} configs={len(krows):3d} "
+            f"vmem_max={_fmt_mib(worst['vmem_total_bytes'])} "
+            f"(allowed {_fmt_mib(worst['vmem_allowed_bytes'])}, "
+            f"at {worst['config']}) padded_ratio_max={pad:.3f}"
+        )
+    sweep = next(
+        (r for r in rows if r.get("config") == "reachable_space_sweep"), None
+    )
+    if sweep:
+        lines.append(
+            f"  reachable-space sweep: {sweep['configs_checked']} tilings, "
+            f"worst {_fmt_mib(sweep['worst_bytes'])} at "
+            f"{sweep['worst_config']} "
+            f"(allowed {_fmt_mib(sweep['allowed_bytes'])})"
+        )
+    return lines
+
+
+def _run_selftest() -> int:
+    results = kernel_check.run_seeded_mutations()
+    bad = 0
+    for r in results:
+        status = "ok" if r["ok"] else "FAIL"
+        sys.stdout.write(
+            f"[{status}] mutation {r['mutation']}: expected "
+            f"{r['expected_rule']}, fired {','.join(r['fired_rules']) or '-'}\n"
+        )
+        bad += 0 if r["ok"] else 1
+    sys.stdout.write(
+        f"selftest: {len(results) - bad}/{len(results)} mutations caught "
+        f"by exactly their expected rule\n"
+    )
+    return 1 if bad else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--masks", default=None,
+        help="comma-separated mask names to audit (default: all)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print the per-config rows as JSON instead of the text report",
+    )
+    ap.add_argument(
+        "--selftest", action="store_true",
+        help="run the seeded-mutation harness instead of the audit",
+    )
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every per-config row")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _run_selftest()
+
+    corpus = kernel_check.golden_corpus()
+    if args.masks:
+        wanted = set(args.masks.split(","))
+        corpus = [
+            s for s in corpus if s.name.split("/", 1)[0] in wanted
+        ]
+    report, rows = kernel_check.run_kernel_audit(corpus)
+
+    if args.json:
+        print(json.dumps({"rows": rows, "summary": report.summary()},
+                         indent=2))
+    else:
+        for line in _per_kernel_report(rows):
+            sys.stdout.write(line + "\n")
+        if args.verbose:
+            for row in rows:
+                sys.stdout.write(f"    {row}\n")
+        for v in report.violations:
+            sys.stdout.write(f"  {v}\n")
+
+    n_configs = len({r["config"] for r in rows if "kernel" in r})
+    n_kernels = len({r["kernel"] for r in rows if "kernel" in r})
+    violations = len(report.violations)
+    status = "FAIL" if violations else "all clean"
+    sys.stdout.write(
+        f"audited {n_kernels} kernel(s) x {n_configs} config(s): {status} "
+        f"({len(report.errors())} error(s), "
+        f"{len(report.warnings())} warning(s), rules "
+        f"{','.join(sorted(report.rules_run))})\n"
+    )
+    if telemetry.enabled():
+        worst = max(
+            (r for r in rows if "vmem_total_bytes" in r),
+            key=lambda r: r["vmem_total_bytes"],
+            default=None,
+        )
+        telemetry.record_event(
+            "kernel_audit",
+            kernels=n_kernels,
+            configs=n_configs,
+            errors=len(report.errors()),
+            warnings=len(report.warnings()),
+            rules_run=sorted(report.rules_run),
+            fired_rules=sorted(report.fired_rules()),
+            vmem_worst_bytes=worst["vmem_total_bytes"] if worst else None,
+            vmem_worst_config=worst["config"] if worst else None,
+            vmem_allowed_bytes=kernel_check.VMEM_ALLOWED_BYTES,
+        )
+    # ANY violation fails the audit: K rules have no warning tier to hide in
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -175,6 +175,24 @@ def aggregate(records: list[dict]) -> dict:
             "wall_ms_total": sum(walls) if walls else None,
         }
 
+    audits = kinds.get("kernel_audit", [])
+    if audits:
+        last = audits[-1]
+        agg["kernel_audit"] = {
+            "runs": len(audits),
+            "kernels": last.get("kernels"),
+            "configs": last.get("configs"),
+            "rules_run": last.get("rules_run"),
+            "errors_total": sum(a.get("errors", 0) for a in audits),
+            "warnings_total": sum(a.get("warnings", 0) for a in audits),
+            "fired_rules": sorted(
+                {r for a in audits for r in a.get("fired_rules", [])}
+            ),
+            "vmem_worst_bytes": last.get("vmem_worst_bytes"),
+            "vmem_worst_config": last.get("vmem_worst_config"),
+            "vmem_allowed_bytes": last.get("vmem_allowed_bytes"),
+        }
+
     res = kinds.get("resilience", [])
     if res:
         by_action: dict[str, int] = {}
@@ -329,6 +347,24 @@ def format_summary(agg: dict) -> str:
             f"errors={pv['errors_total']} warnings={pv['warnings_total']} "
             f"fired={fired}{wall}"
         )
+
+    ka = agg.get("kernel_audit")
+    if ka:
+        lines.append("")
+        fired = ",".join(ka["fired_rules"]) or "none"
+        lines.append(
+            f"kernel audit runs={ka['runs']} kernels={ka['kernels']} "
+            f"configs={ka['configs']} "
+            f"rules={','.join(ka.get('rules_run') or [])} "
+            f"errors={ka['errors_total']} warnings={ka['warnings_total']} "
+            f"fired={fired}"
+        )
+        if ka.get("vmem_worst_bytes") is not None:
+            lines.append(
+                f"  vmem worst: {_fmt_bytes(ka['vmem_worst_bytes'])} of "
+                f"{_fmt_bytes(ka['vmem_allowed_bytes'])} allowed "
+                f"({ka['vmem_worst_config']})"
+            )
 
     rs = agg.get("resilience")
     if rs:

@@ -6,6 +6,7 @@ import textwrap
 
 import magiattention_tpu
 from magiattention_tpu.analysis.lint import (
+    check_env_doc_coverage,
     lint_package,
     load_baseline,
     run,
@@ -111,6 +112,60 @@ def test_covered_and_private_dataclasses_pass(tmp_path):
     assert lint_package(str(tmp_path)) == []
 
 
+def test_flags_undocumented_env_key(tmp_path):
+    _write(tmp_path, "env/knobs.py", """\
+        import os
+
+        def mystery():
+            return os.environ.get("MAGI_ATTENTION_MYSTERY_KNOB", "0")
+    """)
+    findings = lint_package(str(tmp_path))
+    assert _rules(findings) == {"MAGI-L006"}
+    assert "MAGI_ATTENTION_MYSTERY_KNOB" in findings[0].message
+
+
+def test_documented_env_key_passes(tmp_path):
+    root = tmp_path / "pkg"
+    _write(root, "env/knobs.py", """\
+        import os
+
+        def mystery():
+            return os.environ.get("MAGI_ATTENTION_MYSTERY_KNOB", "0")
+    """)
+    # default docs location: <root>/../docs/env_variables.md
+    _write(tmp_path, "docs/env_variables.md", """\
+        | key | effect |
+        | --- | --- |
+        | `MAGI_ATTENTION_MYSTERY_KNOB` | a knob |
+    """)
+    assert lint_package(str(root)) == []
+
+
+def test_env_doc_coverage_docs_path_override(tmp_path):
+    _write(tmp_path, "env/knobs.py", """\
+        KEY = "MAGI_ATTENTION_MYSTERY_KNOB"
+    """)
+    doc = tmp_path / "elsewhere.md"
+    doc.write_text("MAGI_ATTENTION_MYSTERY_KNOB\n")
+    assert check_env_doc_coverage(str(tmp_path), docs_path=str(doc)) == []
+    missing = check_env_doc_coverage(
+        str(tmp_path), docs_path=str(tmp_path / "nope.md")
+    )
+    assert [f.rule for f in missing] == ["MAGI-L006"]
+
+
+def test_non_magi_env_keys_are_exempt(tmp_path):
+    # upstream passthroughs (e.g. JAX_COMPILATION_CACHE_DIR) are not ours
+    # to catalogue
+    _write(tmp_path, "env/passthrough.py", """\
+        import os
+
+        def cache_dir():
+            return os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    """)
+    assert lint_package(str(tmp_path)) == []
+
+
 def test_baseline_suppresses_known_findings(tmp_path):
     _write(tmp_path, "legacy.py", """\
         import os
@@ -139,3 +194,24 @@ def test_baseline_has_no_stale_entries(capsys):
     run(PKG_ROOT, baseline_path=BASELINE)
     out = capsys.readouterr().out
     assert "stale baseline entry" not in out
+
+
+def test_shipped_baseline_is_empty_and_package_clean(capsys):
+    """The legacy debt is burned down: the package passes with NO baseline
+    at all, the shipped baseline file is empty, and no CI warning fires."""
+    assert load_baseline(BASELINE) == set()
+    assert run(PKG_ROOT, baseline_path=None) == 0
+    out = capsys.readouterr().out
+    assert "baseline is non-empty" not in out
+
+
+def test_nonempty_baseline_emits_ci_warning(tmp_path, capsys):
+    _write(tmp_path, "legacy.py", """\
+        import os
+        X = os.environ.get("A")
+    """)
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text("MAGI-L001 legacy.py\n")
+    assert run(str(tmp_path), baseline_path=str(baseline)) == 0
+    out = capsys.readouterr().out
+    assert "warning: lint baseline is non-empty (1 entry)" in out

@@ -157,6 +157,35 @@ def test_report_cli_round_trip(tmp_path, monkeypatch, capsys):
     assert "telemetry summary" in capsys.readouterr().out
 
 
+def test_kernel_audit_report_round_trip(tmp_path, monkeypatch, capsys):
+    """scripts/kernel_audit.py -> JSONL -> scripts/telemetry_report.py:
+    the audit's telemetry record must survive the full round trip into a
+    'kernel audit' summary section."""
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+
+    audit = load_script(
+        os.path.join(REPO, "scripts", "kernel_audit.py"), "kernel_audit"
+    )
+    assert audit.main(["--masks", "causal"]) == 0
+    telemetry.reset()  # flush/close before the reader opens the file
+
+    mod = load_script(REPORT, "telemetry_report")
+    agg = mod.aggregate(mod.load_records([str(tmp_path)]))
+    ka = agg["kernel_audit"]
+    assert ka["runs"] == 1
+    assert ka["kernels"] == 6
+    assert ka["configs"] >= 1
+    assert ka["rules_run"] == ["K1", "K2", "K3", "K4", "K5"]
+    assert ka["errors_total"] == 0 and ka["warnings_total"] == 0
+    assert ka["fired_rules"] == []
+    assert 0 < ka["vmem_worst_bytes"] <= ka["vmem_allowed_bytes"]
+
+    text = mod.format_summary(agg)
+    assert "kernel audit" in text and "vmem worst" in text
+    capsys.readouterr()  # drop the audit CLI's own stdout
+
+
 class _NoClock:
     """time stand-in that fails the test on ANY clock read."""
 
