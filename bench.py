@@ -426,6 +426,132 @@ class _FallbackTiming(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
+# --sparse-suite: padded-vs-band accounting + TF/s per mask family
+# ---------------------------------------------------------------------------
+
+
+def _sparse_families(seq: int) -> dict:
+    """name -> (qr, kr, d_lo, d_hi): the mask families the sparse suite
+    reports on — dense anchors plus the fragmented shapes the extent
+    clamp / mixed dispatch rescue (same generators as the kernel-audit
+    fragmented corpus)."""
+    import numpy as np
+
+    from magiattention_tpu.analysis.kernel_check import _fragmented_masks
+    from magiattention_tpu.kernels.mask_utils import types_to_bands
+
+    qr = np.asarray([[0, seq]], np.int32)
+
+    def band(tm):
+        lo, hi = types_to_bands(qr, qr, np.asarray([tm], np.int32))
+        return qr, qr.copy(), lo, hi
+
+    fams = {
+        "full": band(0),
+        "causal": band(1),
+        "sliding_window": (
+            qr, qr.copy(),
+            np.asarray([-256], np.int32), np.asarray([0], np.int32),
+        ),
+    }
+    fams.update(_fragmented_masks(seq))
+    h = seq // 2
+    q2 = np.asarray([[0, h], [h, seq], [h, seq]], np.int32)
+    k2 = np.asarray([[0, h], [0, h // 2], [h, seq]], np.int32)
+    lo2, hi2 = types_to_bands(q2, k2, np.asarray([1, 0, 1], np.int32))
+    fams["shared_prefix_causal"] = (q2, k2, lo2, hi2)
+    return fams
+
+
+def run_sparse_suite() -> int:
+    """Per-mask-family plan accounting (CPU-safe) + fwd TF/s on silicon.
+
+    Emits one JSON line: for each family the padded/band ratio the
+    un-clamped grid would execute, the post-clamp executed/band ratio from
+    the plan's live extents, and — when a TPU is attached — measured fwd
+    TFLOP/s with FLOPs counted by true band area. Rows land in the
+    committed perf history (benchmarks/history/bench_sparse)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from magiattention_tpu.kernels.ffa import default_blocks, ffa_attn
+    from magiattention_tpu.kernels.ffa_plan import (
+        get_ffa_plan,
+        plan_extent_stats,
+    )
+    from magiattention_tpu import telemetry
+    from magiattention_tpu.kernels.tile_policy import slice_cover_ratios
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    seq = 16384 if on_tpu else 2048
+    HQ, HK, D = (16, 8, 128) if on_tpu else (4, 2, 128)
+    dtype = jnp.bfloat16
+    bq, bk = default_blocks(seq, seq)
+
+    rows = []
+    for name, (qr, kr, lo, hi) in _sparse_families(seq).items():
+        plan = get_ffa_plan(qr, kr, lo, hi, seq, seq, bq, bk)
+        stats = plan_extent_stats(plan)
+        band = telemetry.band_area(qr, kr, lo, hi)
+        ratios = slice_cover_ratios(qr, kr, lo, hi, bq, bk)
+        row = {
+            "family": name,
+            "seq": seq,
+            "block_q": bq,
+            "block_k": bk,
+            "band_elems": int(band),
+            "padded_elems": stats["padded_elems"],
+            "executed_elems": stats["executed_elems"],
+            "padded_band_ratio": round(stats["padded_elems"] / band, 3)
+            if band else None,
+            "executed_band_ratio": round(stats["executed_elems"] / band, 3)
+            if band else None,
+            "worst_slice_cover": round(float(ratios.max()), 3)
+            if len(ratios) else None,
+        }
+        if on_tpu:
+            try:
+                from magiattention_tpu.benchmarking.bench import (
+                    do_bench_scan_slope,
+                )
+
+                rng = np.random.default_rng(0)
+                q = jnp.asarray(rng.standard_normal((seq, HQ, D)), dtype)
+                k = jnp.asarray(rng.standard_normal((seq, HK, D)), dtype)
+                v = jnp.asarray(rng.standard_normal((seq, HK, D)), dtype)
+
+                def body(q):
+                    o, _ = ffa_attn(q, k, v, qr, kr, d_lo=lo, d_hi=hi)
+                    return o.astype(dtype)
+
+                ms = do_bench_scan_slope(body, q, reps=2)
+                row["tflops_fwd"] = round(
+                    4 * band * D * HQ / (ms * 1e-3) / 1e12, 2
+                )
+            except Exception as e:  # noqa: BLE001
+                row["tflops_error"] = f"{type(e).__name__}: {e}"[:120]
+        rows.append(row)
+
+    try:
+        from magiattention_tpu.benchmarking.perf_report import append_row
+
+        for row in rows:
+            append_row("bench_sparse", {"backend": backend, **row})
+    except Exception:
+        pass
+    return _emit(
+        {
+            "metric": "ffa_sparse_suite",
+            "backend": backend,
+            "families": rows,
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
 # parent: subprocess isolation + bounded retry + degraded-output path
 # ---------------------------------------------------------------------------
 
@@ -467,4 +593,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--sparse-suite" in sys.argv:
+        sys.exit(run_sparse_suite())
     sys.exit(run_worker() if "--worker" in sys.argv else main())

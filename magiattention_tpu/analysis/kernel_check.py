@@ -27,7 +27,11 @@ contract is checked against five rule families:
   dkv-GQA-pack bug class).
 - **K3** index-map bounds: every index_map output x block shape stays
   inside its operand for ALL grid points (vectorized numpy evaluation of
-  the captured index_map lambdas over the whole grid).
+  the captured index_map lambdas over the whole grid). The extent half
+  (:func:`check_k3_extents`) proves the EQ0..EK1 live-extent prefetch
+  columns — the state the clamp path skips dot chunks on — match a host
+  recomputation from the band geometry and respect tile bounds and the
+  sublane/lane chunking quanta.
 - **K4** dtype/precision: fp32 accumulator scratch, fp32-preferred
   ``dot_general``s, declared out dtypes honored (no implicit f32->bf16
   truncation before the final guarded write).
@@ -49,6 +53,16 @@ from pathlib import Path
 
 import numpy as np
 
+from ..kernels.ffa_plan import (
+    EK1,
+    EQ0,
+    LANE_QUANTUM,
+    META_DIM,
+    QE,
+    QS,
+    SUBLANE_QUANTUM,
+    _extend_meta_extents,
+)
 from ..kernels.tile_policy import VMEM_BUDGET as POLICY_VMEM_BUDGET
 from ..utils.mem_budget import (
     VMEM_ALLOWED_BYTES,
@@ -73,6 +87,7 @@ __all__ = [
     "capture_ffa_contracts",
     "check_contract",
     "check_env_keys",
+    "check_k3_extents",
     "check_kernel_sources",
     "check_reachable_space",
     "discover_pallas_sites",
@@ -690,6 +705,66 @@ def check_k3_bounds(
                 )
 
 
+def check_k3_extents(
+    report: VerifyReport, contract: KernelContract, site: str
+) -> None:
+    """K3, extent half: the EQ0..EK1 live-extent meta columns are prefetch
+    state the clamp path uses to SKIP dot_general chunks, so a wrong row
+    silently drops (or re-adds) attention mass instead of faulting. Prove
+    every captured row equals the host-side recomputation from the 9-col
+    band geometry (``ffa_plan._extend_meta_extents``) and sits inside the
+    tile at the sublane/lane quanta the kernels chunk at."""
+    if contract.num_scalar_prefetch < 3:
+        return
+    meta = np.asarray(contract.prefetch[2])
+    if meta.ndim != 2 or meta.shape[1] < META_DIM:
+        return  # pre-extent 9-col meta: nothing to prove
+    report.mark_run("K3")
+    info = _contract_shape_info(contract)
+    bq, bk = info["bq"], info["bk"]
+    work_qt = np.asarray(contract.prefetch[0])
+    work_kt = np.asarray(contract.prefetch[1])
+    ext = meta[:, EQ0 : EK1 + 1].astype(np.int64)
+    want = _extend_meta_extents(
+        meta[:, :EQ0].astype(np.int32), work_qt, work_kt, bq, bk
+    )[:, EQ0 : EK1 + 1].astype(np.int64)
+    bad = np.nonzero((ext != want).any(axis=1))[0]
+    for w in bad[:8]:
+        report.add(
+            "K3", ERROR, f"{site} meta[{int(w)}]",
+            f"extent columns {ext[w].tolist()} != host recomputation "
+            f"{want[w].tolist()} from the band geometry — the clamp "
+            f"path would skip live chunks or execute dead ones",
+        )
+    if len(bad) > 8:
+        report.add(
+            "K3", ERROR, site,
+            f"... and {len(bad) - 8} more extent rows disagree",
+        )
+    eq0, eq1, ek0, ek1 = ext[:, 0], ext[:, 1], ext[:, 2], ext[:, 3]
+    oob = (
+        (eq0 < 0) | (eq1 > bq) | (eq0 > eq1)
+        | (ek0 < 0) | (ek1 > bk) | (ek0 > ek1)
+    )
+    for w in np.nonzero(oob)[0][:8]:
+        report.add(
+            "K3", ERROR, f"{site} meta[{int(w)}]",
+            f"extent {ext[w].tolist()} escapes tile ({bq}, {bk}) or is "
+            f"inverted",
+        )
+    misaligned = (
+        (eq0 % SUBLANE_QUANTUM != 0) | (eq1 % SUBLANE_QUANTUM != 0)
+        | (ek0 % LANE_QUANTUM != 0) | (ek1 % LANE_QUANTUM != 0)
+    )
+    for w in np.nonzero(misaligned & ~oob)[0][:8]:
+        report.add(
+            "K3", ERROR, f"{site} meta[{int(w)}]",
+            f"extent {ext[w].tolist()} not aligned to "
+            f"({SUBLANE_QUANTUM}, {LANE_QUANTUM}) quanta — chunk "
+            f"liveness tests would straddle a partially-live chunk",
+        )
+
+
 def padding_stats(
     contract: KernelContract, sq: int, sk: int
 ) -> dict:
@@ -766,6 +841,7 @@ def check_contract(
     site = site or contract.kernel_name
     check_k1_vmem(report, contract, site)
     check_k3_bounds(report, contract, site)
+    check_k3_extents(report, contract, site)
     check_k4_dtypes(report, contract, site)
 
 
@@ -1114,6 +1190,32 @@ def _canonical_masks(seq: int = _SEQ) -> dict[str, tuple]:
     return masks
 
 
+def _fragmented_masks(seq: int = _SEQ) -> dict[str, tuple]:
+    """Sparse masks whose tiles are mostly padding at the default blocks —
+    the shapes the extent-clamp/mixed-dispatch rescue targets. Shared with
+    the verify_plans and parity corpora (video-style windowed frames via
+    utils/sparse_utils, plus a fine block-diagonal)."""
+    from ..kernels.mask_utils import types_to_bands
+    from ..utils.sparse_utils import block_mask_to_ranges, make_video_block_mask
+
+    blk = 128
+    frames = seq // blk
+    bm = make_video_block_mask(frames, 1, window_frames=2)
+    vq, vk, vt = block_mask_to_ranges(bm, blk, blk)
+    vqr = np.asarray(vq.to_naive_ranges(), dtype=np.int32)
+    vkr = np.asarray(vk.to_naive_ranges(), dtype=np.int32)
+    vtm = np.asarray([t.to_int_type() for t in vt], dtype=np.int32)
+    vlo, vhi = types_to_bands(vqr, vkr, vtm)
+
+    n = seq // blk
+    dqr = np.asarray([[i * blk, (i + 1) * blk] for i in range(n)], np.int32)
+    dlo, dhi = types_to_bands(dqr, dqr, np.zeros(n, dtype=np.int32))
+    return {
+        "video_sparse": (vqr, vkr, vlo, vhi),
+        "block_diag_sparse": (dqr, dqr.copy(), dlo, dhi),
+    }
+
+
 def _largest_reachable_blocks(seq: int, itemsize: int) -> tuple[int, int]:
     """Max-area tiling reachable for EVERY pass at this dtype — the fwd
     blocks serve dq/dkv whenever no override is active, so the audit's
@@ -1187,6 +1289,24 @@ def golden_corpus(seq: int = _SEQ) -> list[AuditSpec]:
             sq=ragged, sk=ragged, hq=4, hk=2, blocks=(256, 512),
         )
     )
+    # fragmented-mask riders: partial tiles dominate, so the extent half
+    # of K3 (check_k3_extents) is exercised on non-trivial live
+    # sub-rectangles. The coarse-block variants are the extent-clamped
+    # single-pass shape; the fine-block variants are what the mixed
+    # dispatch's fragmented branch runs.
+    for mask_name, (qr, kr, lo, hi) in _fragmented_masks(seq).items():
+        for blocks, tag in (((256, 512), "coarse"), ((128, 128), "fine")):
+            for g in (1, 4):
+                specs.append(
+                    AuditSpec(
+                        name=(
+                            f"{mask_name}/bfloat16/g{g}/"
+                            f"b{blocks[0]}x{blocks[1]}/{tag}"
+                        ),
+                        q_ranges=qr, k_ranges=kr, d_lo=lo, d_hi=hi,
+                        sq=seq, sk=seq, hq=2 * g, hk=2, blocks=blocks,
+                    )
+                )
     return specs
 
 
@@ -1375,9 +1495,22 @@ def run_seeded_mutations() -> list[dict]:
             consumed={"MAGI_ATTENTION_UNLISTED_KNOB": {"ffa.py"}},
         )
 
+    def bad_extent(report: VerifyReport) -> None:
+        # zero one real item's live k extent: stays aligned and in-bounds,
+        # so ONLY the host-recomputation equality can catch the clamp path
+        # silently skipping a live chunk
+        meta = base.prefetch[2].copy()
+        w = int(np.nonzero(meta[:, QE] > meta[:, QS])[0][0])
+        meta[w, EK1] = meta[w, EK1] - LANE_QUANTUM
+        mut = replace(
+            base, prefetch=(base.prefetch[0], base.prefetch[1], meta)
+        )
+        check_contract(report, mut, "mutation:corrupted_extent_row")
+
     run("oversized_scratch", "K1", oversized)
     run("swapped_index_map_axes", "K3", swapped)
     run("missing_accumulator_init", "K2", no_init)
     run("bf16_accumulator", "K4", bf16_scratch)
     run("unlisted_env_key", "K5", unlisted_key)
+    run("corrupted_extent_row", "K3", bad_extent)
     return results

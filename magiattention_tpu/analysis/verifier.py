@@ -591,6 +591,65 @@ def check_tiles(
             )
 
 
+def check_plan_extents(report: VerifyReport, plan) -> None:
+    """R5, extent half: an FFA plan's live-extent meta columns (EQ0..EK1)
+    must equal the host recomputation from its own 9-col band geometry,
+    for BOTH triples (q-major and k-major), and the executed-element count
+    they imply must not exceed the padded tile work. The kernels skip
+    dot_general chunks on these columns (kernels/ffa.py clamp path), so a
+    stale or truncated row silently drops attention mass — the same
+    invariant rule K3's extent half proves on captured contracts, applied
+    here to the plan object before it ever reaches a kernel."""
+    import numpy as np
+
+    from ..kernels.ffa_plan import (
+        EQ0,
+        META_DIM,
+        _extend_meta_extents,
+        plan_extent_stats,
+    )
+
+    report.mark_run("R5")
+    triples = (
+        ("meta", plan.meta, plan.work_qt, plan.work_kt),
+        ("meta_t", plan.meta_t, plan.work_qt_t, plan.work_kt_t),
+    )
+    for which, meta, wq, wk in triples:
+        meta = np.asarray(meta)
+        if meta.ndim != 2 or meta.shape[1] != META_DIM:
+            report.add(
+                "R5", ERROR, which,
+                f"plan meta has {meta.shape} columns, expected {META_DIM} "
+                "(9 band cols + 4 live-extent cols)",
+            )
+            continue
+        want = _extend_meta_extents(
+            meta[:, :EQ0].astype(np.int32), np.asarray(wq), np.asarray(wk),
+            plan.block_q, plan.block_k,
+        )
+        bad = np.nonzero((meta != want).any(axis=1))[0]
+        for w in bad[:8]:
+            report.add(
+                "R5", ERROR, f"{which}[{int(w)}]",
+                f"extent columns {meta[w, EQ0:].tolist()} != host "
+                f"recomputation {want[w, EQ0:].tolist()} from the row's "
+                "band geometry",
+            )
+        if len(bad) > 8:
+            report.add(
+                "R5", ERROR, which,
+                f"... and {len(bad) - 8} more extent rows disagree",
+            )
+    stats = plan_extent_stats(plan)
+    if stats["executed_elems"] > stats["padded_elems"]:
+        report.add(
+            "R5", ERROR, "extent_stats",
+            f"executed elements {stats['executed_elems']} exceed the "
+            f"padded tile work {stats['padded_elems']} — extents escape "
+            "their tiles",
+        )
+
+
 # ---------------------------------------------------------------------------
 # orchestrators
 # ---------------------------------------------------------------------------

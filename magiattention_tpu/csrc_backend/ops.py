@@ -122,9 +122,14 @@ def ffa_plan_native(
 
     Returns the 6 plan arrays (work_qt, work_kt, meta, work_qt_t,
     work_kt_t, meta_t) with dummy items inserted for empty tiles, matching
-    kernels/ffa_plan.build_ffa_plan exactly.
+    the first 9 meta columns of kernels/ffa_plan.build_ffa_plan exactly.
+    The meta arrays are 9 columns wide — the fixed row stride the C fill
+    routine writes (csrc/magi_host.cpp magi_ffa_plan_fill); the caller
+    (build_ffa_plan) appends the live-extent columns host-side.
     """
-    from ..kernels.ffa_plan import DHI, DLO, IS_FIRST, IS_LAST, META_DIM
+    from ..kernels.ffa_plan import DHI, DLO, IS_FIRST, IS_LAST
+
+    native_meta_dim = 9  # must match the `meta + p * 9` stride in C
 
     lib = get_lib()
     qr = np.ascontiguousarray(q_ranges, dtype=np.int32)
@@ -153,7 +158,7 @@ def ffa_plan_native(
         total = int(sizes.sum())
         work_a = np.zeros(total, dtype=np.int32)
         work_b = np.zeros(total, dtype=np.int32)
-        meta = np.zeros((total, META_DIM), dtype=np.int32)
+        meta = np.zeros((total, native_meta_dim), dtype=np.int32)
         empty = counts == 0
         if empty.any():
             pos = offsets[empty]

@@ -164,6 +164,10 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     "MAGI_ATTENTION_FFA_GQA_PACK_DQ",
     "MAGI_ATTENTION_FFA_GQA_PACK_DKV",
     "MAGI_ATTENTION_FFA_AUTO_TILE",
+    # extent clamping changes the lowered kernel bodies; mixed blocks
+    # changes which plans/kernels a mask dispatches to
+    "MAGI_ATTENTION_FFA_EXTENT_CLAMP",
+    "MAGI_ATTENTION_FFA_MIXED_BLOCKS",
     # wire-tier selection changes the traced collective program
     "MAGI_ATTENTION_RAGGED_GRPCOLL",
     "MAGI_ATTENTION_SPLIT_ALIGNMENT",

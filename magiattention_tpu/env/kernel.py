@@ -61,6 +61,26 @@ def ffa_native_plan() -> str:
     return _get_str("MAGI_ATTENTION_NATIVE_FFA_PLAN", "auto").lower()
 
 
+def ffa_extent_clamp() -> bool:
+    """Clamp the FFA kernels' dot_general / accumulator updates to each
+    work item's live extent (the EQ0..EK1 meta columns the plan builder
+    derives from the band geometry): partially-filled tiles split their
+    lane dimension into chunks and skip the chunks the band never touches,
+    so a 10%-live tile costs ~10% instead of 100%. ON by default; the
+    legacy single-dot bodies are bit-preserved under 0."""
+    return _get_int("MAGI_ATTENTION_FFA_EXTENT_CLAMP", 1) == 1
+
+
+def ffa_mixed_blocks() -> str:
+    """Mixed-granularity block dispatch: 'auto' (split the slice set into a
+    coarse-block dense pass and a fine-block fragmented pass when the plan
+    cost model says the split + LSE merge wins), '1' (split whenever a
+    non-trivial partition exists), '0' (never). Fragmentation is judged by
+    the per-slice padded/band cover ratio (tile_policy.slice_cover_ratios);
+    the two passes are merged through the standard LSE-merge math."""
+    return _get_str("MAGI_ATTENTION_FFA_MIXED_BLOCKS", "auto").lower()
+
+
 def ffa_gqa_pack_dq() -> bool:
     """GQA-pack the dq backward kernel (grid (hk, W)): k/v fetched once
     per work item instead of per q-head, s/dp matmuls g x taller,

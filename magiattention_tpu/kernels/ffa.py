@@ -57,6 +57,10 @@ from ..env import kernel as env_kernel
 from ..resilience.inject import maybe_inject
 from ..utils.mem_budget import VMEM_ALLOWED_BYTES, ffa_kernel_residency
 from .ffa_plan import (  # noqa: F401
+    EK0,
+    EK1,
+    EQ0,
+    EQ1,
     IS_FULL,
     DHI,
     DLO,
@@ -198,6 +202,35 @@ def _lane_tile(col, width: int):
     return jnp.tile(col, (1, width // NUM_LANES))
 
 
+# extent-clamp chunking: at most this many lane-dim chunks per tile — more
+# chunks skip finer-grained dead work but each live chunk re-pays the MXU
+# ramp and mask arithmetic, and past ~8 the chunk dots drop under the MXU's
+# efficient minimum anyway
+_MAX_CLAMP_CHUNKS = 8
+
+
+def _clamp_chunks(width: int) -> int:
+    """Number of lane-dimension chunks the extent-clamped kernel bodies
+    split a ``width``-wide tile into; 0 = clamping off (the legacy
+    single-dot bodies lower unchanged). Chunk width must stay a lane-quantum
+    multiple (``_lane_tile``/Mosaic layout rule), so the count is the
+    largest divisor of ``width // NUM_LANES`` within the chunk cap."""
+    if not env_kernel.ffa_extent_clamp() or width % NUM_LANES:
+        return 0
+    m = width // NUM_LANES
+    return max(c for c in range(1, min(_MAX_CLAMP_CHUNKS, m) + 1) if m % c == 0)
+
+
+def _item_extents(meta_ref, w):
+    """(eq0, eq1, ek0, ek1, live) scalars of work item w: the tile-local
+    live sub-rectangle the plan builder derived from the band geometry
+    (ffa_plan._extend_meta_extents). ``live`` is False exactly for dummy /
+    pad_plan filler items (all-zero extent)."""
+    eq0, eq1 = meta_ref[w, EQ0], meta_ref[w, EQ1]
+    ek0, ek1 = meta_ref[w, EK0], meta_ref[w, EK1]
+    return eq0, eq1, ek0, ek1, (eq1 > eq0) & (ek1 > ek0)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -215,6 +248,7 @@ def _fwd_kernel(
     bq: int,
     bk: int,
     emit_ml: bool,
+    nc: int,
 ):
     if emit_ml:
         out_ref, lse_ref, ml_ref, m_scr, l_scr, acc_scr = rest
@@ -238,23 +272,18 @@ def _fwd_kernel(
 
     q = q_ref[0]  # pre-scaled by softmax_scale (* log2e when softcap-free)
     k = k_ref[0]
-    s_raw = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    if softcap > 0.0:
-        s_raw = softcap * jnp.tanh(s_raw / softcap)
 
-    def update(s):
+    def update(s, v_blk, width: int):
         m_prev = m_scr[...]  # (bq, NUM_LANES)
         m_blk = jnp.max(s, axis=1)[:, None]  # (bq, 1)
         m_new = jnp.maximum(m_prev, m_blk)  # (bq, NUM_LANES)
-        p = exp_fn(s - _lane_tile(m_new, bk))
+        p = exp_fn(s - _lane_tile(m_new, width))
         alpha = exp_fn(m_prev - m_new)  # (bq, NUM_LANES); ==1 while empty
 
         l_new = l_scr[...] * alpha + jnp.sum(p, axis=1)[:, None]
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype),
-            v_ref[0],
+            v_blk,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -262,23 +291,67 @@ def _fwd_kernel(
         m_scr[:] = m_new
         l_scr[:] = l_new
 
-    # interior tiles skip the band-mask arithmetic entirely (VPU is the
-    # bottleneck with bf16 MXUs; splash's should-not-mask split)
-    @pl.when(is_full == 1)
-    def _():
-        update(s_raw)
-
-    @pl.when(is_full == 0)
-    def _():
-        q_base = work_qt_ref[w] * bq
-        k_base = work_kt_ref[w] * bk
-        update(
-            jnp.where(
-                _item_mask(meta_ref, w, q_base, k_base, bq, bk),
-                s_raw,
-                MASK_VALUE,
-            )
+    def score(k_blk):
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
+        if softcap > 0.0:
+            s = softcap * jnp.tanh(s / softcap)
+        return s
+
+    if nc == 0:
+        s_raw = score(k)
+
+        # interior tiles skip the band-mask arithmetic entirely (VPU is the
+        # bottleneck with bf16 MXUs; splash's should-not-mask split)
+        @pl.when(is_full == 1)
+        def _():
+            update(s_raw, v_ref[0], bk)
+
+        @pl.when(is_full == 0)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            update(
+                jnp.where(
+                    _item_mask(meta_ref, w, q_base, k_base, bq, bk),
+                    s_raw,
+                    MASK_VALUE,
+                ),
+                v_ref[0],
+                bk,
+            )
+    else:
+        # extent-clamped body: partial tiles run only the k chunks the live
+        # extent touches — skipped chunks lie fully outside the band, so
+        # their legacy contribution was exactly 0 (masked p underflows to
+        # 0.0; never-live rows are discarded by finalize's empty threshold)
+        ck = bk // nc
+        _, _, ek0, ek1, live = _item_extents(meta_ref, w)
+
+        @pl.when(is_full == 1)
+        def _():
+            update(score(k), v_ref[0], bk)
+
+        for c in range(nc):
+            c0 = c * ck
+
+            @pl.when((is_full == 0) & live & (ek0 < c0 + ck) & (ek1 > c0))
+            def _(c0=c0):
+                q_base = work_qt_ref[w] * bq
+                k_base = work_kt_ref[w] * bk
+                update(
+                    jnp.where(
+                        _item_mask(
+                            meta_ref, w, q_base, k_base + c0, bq, ck
+                        ),
+                        score(k[c0 : c0 + ck]),
+                        MASK_VALUE,
+                    ),
+                    v_ref[0][c0 : c0 + ck],
+                    ck,
+                )
 
     @pl.when(is_last == 1)
     def _():
@@ -371,6 +444,7 @@ def _ffa_fwd_pallas(params: FFAParams, work_qt, work_kt, meta, q_t, k_t, v_t):
         bq=bq,
         bk=bk,
         emit_ml=emit_ml,
+        nc=_clamp_chunks(bk),
     )
     lse_shape = jax.ShapeDtypeStruct((hq, sqp, NUM_LANES), jnp.float32)
     outs = pl.pallas_call(
@@ -418,6 +492,7 @@ def _fwd_kernel_gqa(
     bq: int,
     bk: int,
     g: int,
+    nc: int,
 ):
     """GQA-packed forward: the whole query group of one kv head per grid
     step. vs :func:`_fwd_kernel`: grid (hk, W) instead of (hq, W), so each
@@ -444,22 +519,17 @@ def _fwd_kernel_gqa(
     # (g, bq, d) block -> (g*bq, d) packed rows: contiguous sublane merge
     q = q_ref[0].reshape(g * bq, d)
     k = k_ref[0]
-    s_raw = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    if softcap > 0.0:
-        s_raw = softcap * jnp.tanh(s_raw / softcap)
 
-    def update(s):
+    def update(s, v_blk, width: int):
         m_prev = m_scr[...]  # (g*bq, NUM_LANES)
         m_blk = jnp.max(s, axis=1)[:, None]
         m_new = jnp.maximum(m_prev, m_blk)
-        p = exp_fn(s - _lane_tile(m_new, bk))
+        p = exp_fn(s - _lane_tile(m_new, width))
         alpha = exp_fn(m_prev - m_new)
         l_new = l_scr[...] * alpha + jnp.sum(p, axis=1)[:, None]
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype),
-            v_ref[0],
+            v_blk,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -467,21 +537,67 @@ def _fwd_kernel_gqa(
         m_scr[:] = m_new
         l_scr[:] = l_new
 
-    @pl.when(is_full == 1)
-    def _():
-        update(s_raw)
-
-    @pl.when(is_full == 0)
-    def _():
-        q_base = work_qt_ref[w] * bq
-        k_base = work_kt_ref[w] * bk
-        update(
-            jnp.where(
-                _item_mask(meta_ref, w, q_base, k_base, bq, bk, repeat=g),
-                s_raw,
-                MASK_VALUE,
-            )
+    def score(k_blk):
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
+        if softcap > 0.0:
+            s = softcap * jnp.tanh(s / softcap)
+        return s
+
+    if nc == 0:
+        s_raw = score(k)
+
+        @pl.when(is_full == 1)
+        def _():
+            update(s_raw, v_ref[0], bk)
+
+        @pl.when(is_full == 0)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            update(
+                jnp.where(
+                    _item_mask(
+                        meta_ref, w, q_base, k_base, bq, bk, repeat=g
+                    ),
+                    s_raw,
+                    MASK_VALUE,
+                ),
+                v_ref[0],
+                bk,
+            )
+    else:
+        # extent-clamped body (see _fwd_kernel): the live k extent is
+        # head-independent — the packed heads share the work item's band —
+        # so chunk skipping is uniform across the packed rows
+        ck = bk // nc
+        _, _, ek0, ek1, live = _item_extents(meta_ref, w)
+
+        @pl.when(is_full == 1)
+        def _():
+            update(score(k), v_ref[0], bk)
+
+        for c in range(nc):
+            c0 = c * ck
+
+            @pl.when((is_full == 0) & live & (ek0 < c0 + ck) & (ek1 > c0))
+            def _(c0=c0):
+                q_base = work_qt_ref[w] * bq
+                k_base = work_kt_ref[w] * bk
+                update(
+                    jnp.where(
+                        _item_mask(
+                            meta_ref, w, q_base, k_base + c0, bq, ck,
+                            repeat=g,
+                        ),
+                        score(k[c0 : c0 + ck]),
+                        MASK_VALUE,
+                    ),
+                    v_ref[0][c0 : c0 + ck],
+                    ck,
+                )
 
     @pl.when(is_last == 1)
     def _():
@@ -558,7 +674,8 @@ def _ffa_fwd_pallas_gqa(
         ],
     )
     kernel = partial(
-        _fwd_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g
+        _fwd_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g,
+        nc=_clamp_chunks(bk),
     )
     outs = pl.pallas_call(
         kernel,
@@ -633,6 +750,7 @@ def _bwd_dq_kernel(
     softcap: float,
     bq: int,
     bk: int,
+    nc: int,
 ):
     w = pl.program_id(1)
     is_first = meta_ref[w, IS_FIRST]
@@ -647,27 +765,24 @@ def _bwd_dq_kernel(
 
     q = q_ref[0]  # pre-scaled by softmax_scale (* log2e when softcap-free)
     k = k_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    if softcap > 0.0:
-        sc = softcap * jnp.tanh(s / softcap)
-        dcap = 1.0 - (sc / softcap) ** 2
-    else:
-        sc = s
-        dcap = None
 
     # lse/delta live q-in-lanes: ref block (1, bq); column views via
     # expand_dims (splash dq idiom). lse arrives in natural log; the exp2
     # path converts the (bq,1) column, never the (bq,bk) tile.
     lse = jnp.expand_dims(lse_ref[0], -1)  # (bq, 1)
     delta = jnp.expand_dims(delta_ref[0], -1)  # (bq, 1)
-    dp = jax.lax.dot_general(
-        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
 
-    def accum(sm, masked: bool):
+    def score(k_blk):
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if softcap > 0.0:
+            sc = softcap * jnp.tanh(s / softcap)
+            return sc, 1.0 - (sc / softcap) ** 2
+        return s, None
+
+    def accum(sm, dcap, dp, k_blk, masked: bool):
         if masked:
             neg = lse <= EMPTY_THRESH  # uncovered rows (host clamps -inf)
             lse_safe = jnp.where(neg, 0.0, lse)
@@ -682,25 +797,67 @@ def _bwd_dq_kernel(
         if dcap is not None:
             ds = ds * dcap
         dq_scr[:] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), k, (((1,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(is_full == 1)
-    def _():
-        accum(sc, masked=False)
-
-    @pl.when(is_full == 0)
-    def _():
-        q_base = work_qt_ref[w] * bq
-        k_base = work_kt_ref[w] * bk
-        accum(
-            jnp.where(
-                _item_mask(meta_ref, w, q_base, k_base, bq, bk),
-                sc, MASK_VALUE,
-            ),
-            masked=True,
+    def dp_of(v_blk):
+        return jax.lax.dot_general(
+            do_ref[0], v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
+
+    if nc == 0:
+        sc, dcap = score(k)
+        dp = dp_of(v_ref[0])
+
+        @pl.when(is_full == 1)
+        def _():
+            accum(sc, dcap, dp, k, masked=False)
+
+        @pl.when(is_full == 0)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            accum(
+                jnp.where(
+                    _item_mask(meta_ref, w, q_base, k_base, bq, bk),
+                    sc, MASK_VALUE,
+                ),
+                dcap, dp, k,
+                masked=True,
+            )
+    else:
+        # extent-clamped body: skipped k chunks are fully masked, and the
+        # masked path's p is exactly 0 there (exp underflow / neg-row
+        # forcing), so dropping them does not change dq
+        ck = bk // nc
+        _, _, ek0, ek1, live = _item_extents(meta_ref, w)
+
+        @pl.when(is_full == 1)
+        def _():
+            sc, dcap = score(k)
+            accum(sc, dcap, dp_of(v_ref[0]), k, masked=False)
+
+        for c in range(nc):
+            c0 = c * ck
+
+            @pl.when((is_full == 0) & live & (ek0 < c0 + ck) & (ek1 > c0))
+            def _(c0=c0):
+                q_base = work_qt_ref[w] * bq
+                k_base = work_kt_ref[w] * bk
+                k_c = k[c0 : c0 + ck]
+                sc, dcap = score(k_c)
+                accum(
+                    jnp.where(
+                        _item_mask(
+                            meta_ref, w, q_base, k_base + c0, bq, ck
+                        ),
+                        sc, MASK_VALUE,
+                    ),
+                    dcap, dp_of(v_ref[0][c0 : c0 + ck]), k_c,
+                    masked=True,
+                )
 
     @pl.when(is_last == 1)
     def _():
@@ -752,7 +909,7 @@ def _ffa_bwd_dq_pallas(
     )
     kernel = partial(
         _bwd_dq_kernel, softcap=params.softcap,
-        bq=bq, bk=bk,
+        bq=bq, bk=bk, nc=_clamp_chunks(bk),
     )
     (dq_t,) = pl.pallas_call(
         kernel,
@@ -784,6 +941,7 @@ def _bwd_dq_kernel_gqa(
     bq: int,
     bk: int,
     g: int,
+    nc: int,
 ):
     """GQA-packed dq: grid (hk, W) — the whole query group of one kv head
     per grid step (vs :func:`_bwd_dq_kernel`'s (hq, W)). k/v are fetched
@@ -807,25 +965,23 @@ def _bwd_dq_kernel_gqa(
     d = q_ref.shape[-1]
     q = q_ref[0].reshape(g * bq, d)  # pre-scaled on host
     k = k_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    if softcap > 0.0:
-        sc = softcap * jnp.tanh(s / softcap)
-        dcap = 1.0 - (sc / softcap) ** 2
-    else:
-        sc = s
-        dcap = None
 
     lse = jnp.expand_dims(lse_ref[0], -1)  # (g*bq, 1), tile-packed rows
     delta = jnp.expand_dims(delta_ref[0], -1)
     dv = v_ref.shape[-1]
-    dp = jax.lax.dot_general(
-        do_ref[0].reshape(g * bq, dv), v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    do = do_ref[0].reshape(g * bq, dv)
 
-    def accum(sm, masked: bool):
+    def score(k_blk):
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if softcap > 0.0:
+            sc = softcap * jnp.tanh(s / softcap)
+            return sc, 1.0 - (sc / softcap) ** 2
+        return s, None
+
+    def accum(sm, dcap, dp, k_blk, masked: bool):
         if masked:
             neg = lse <= EMPTY_THRESH
             lse_safe = jnp.where(neg, 0.0, lse)
@@ -839,25 +995,69 @@ def _bwd_dq_kernel_gqa(
         if dcap is not None:
             ds = ds * dcap
         dq_scr[:] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), k, (((1,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(is_full == 1)
-    def _():
-        accum(sc, masked=False)
-
-    @pl.when(is_full == 0)
-    def _():
-        q_base = work_qt_ref[w] * bq
-        k_base = work_kt_ref[w] * bk
-        accum(
-            jnp.where(
-                _item_mask(meta_ref, w, q_base, k_base, bq, bk, repeat=g),
-                sc, MASK_VALUE,
-            ),
-            masked=True,
+    def dp_of(v_blk):
+        return jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
+
+    if nc == 0:
+        sc, dcap = score(k)
+        dp = dp_of(v_ref[0])
+
+        @pl.when(is_full == 1)
+        def _():
+            accum(sc, dcap, dp, k, masked=False)
+
+        @pl.when(is_full == 0)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            accum(
+                jnp.where(
+                    _item_mask(
+                        meta_ref, w, q_base, k_base, bq, bk, repeat=g
+                    ),
+                    sc, MASK_VALUE,
+                ),
+                dcap, dp, k,
+                masked=True,
+            )
+    else:
+        # extent-clamped body (see _bwd_dq_kernel); the live k extent is
+        # shared by the packed heads
+        ck = bk // nc
+        _, _, ek0, ek1, live = _item_extents(meta_ref, w)
+
+        @pl.when(is_full == 1)
+        def _():
+            sc, dcap = score(k)
+            accum(sc, dcap, dp_of(v_ref[0]), k, masked=False)
+
+        for c in range(nc):
+            c0 = c * ck
+
+            @pl.when((is_full == 0) & live & (ek0 < c0 + ck) & (ek1 > c0))
+            def _(c0=c0):
+                q_base = work_qt_ref[w] * bq
+                k_base = work_kt_ref[w] * bk
+                k_c = k[c0 : c0 + ck]
+                sc, dcap = score(k_c)
+                accum(
+                    jnp.where(
+                        _item_mask(
+                            meta_ref, w, q_base, k_base + c0, bq, ck,
+                            repeat=g,
+                        ),
+                        sc, MASK_VALUE,
+                    ),
+                    dcap, dp_of(v_ref[0][c0 : c0 + ck]), k_c,
+                    masked=True,
+                )
 
     @pl.when(is_last == 1)
     def _():
@@ -926,6 +1126,7 @@ def _ffa_bwd_dq_pallas_gqa(
     )
     kernel = partial(
         _bwd_dq_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g,
+        nc=_clamp_chunks(bk),
     )
     (dq_g,) = pl.pallas_call(
         kernel,
@@ -1001,6 +1202,7 @@ def _bwd_dkv_kernel(
     bq: int,
     bk: int,
     group: int,
+    nc: int,
 ):
     # grid (hk, W, gi): the GQA group dim is innermost so dk/dv accumulate
     # over the g q-heads of a kv head in VMEM scratch — the kv-head output
@@ -1025,63 +1227,104 @@ def _bwd_dkv_kernel(
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    # s_t: (bk, bq) — k rows, q cols
-    s_t = jax.lax.dot_general(
-        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    if softcap > 0.0:
-        sc_t = softcap * jnp.tanh(s_t / softcap)
-        dcap_t = 1.0 - (sc_t / softcap) ** 2
-    else:
-        sc_t = s_t
-        dcap_t = None
 
-    # lse/delta q-in-lanes rows: ref block (sublanes, bq) -> (1, bq) views
-    lse = lse_ref[:1, :]  # (1, bq)
-    delta = delta_ref[:1, :]  # (1, bq)
-    dp_t = jax.lax.dot_general(
-        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    def score(q_blk):
+        # s_t: (bk, rows(q_blk)) — k rows, q cols
+        s_t = jax.lax.dot_general(
+            k, q_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if softcap > 0.0:
+            sc_t = softcap * jnp.tanh(s_t / softcap)
+            return sc_t, 1.0 - (sc_t / softcap) ** 2
+        return s_t, None
 
-    def accum(sm_t, masked: bool):
+    def accum(sm_t, dcap_t, lse_c, delta_c, do_blk, q_blk, masked: bool):
+        dp_t = jax.lax.dot_general(
+            v, do_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         if masked:
-            neg = lse <= EMPTY_THRESH
-            lse_safe = jnp.where(neg, 0.0, lse)
+            neg = lse_c <= EMPTY_THRESH
+            lse_safe = jnp.where(neg, 0.0, lse_c)
             if use_exp2:
                 lse_safe = lse_safe * LOG2E
             p_t = exp_fn(sm_t - lse_safe)
             p_t = jnp.where(neg, 0.0, p_t)
         else:
-            p_t = exp_fn(sm_t - (lse * LOG2E if use_exp2 else lse))
+            p_t = exp_fn(sm_t - (lse_c * LOG2E if use_exp2 else lse_c))
         dv_scr[:] += jax.lax.dot_general(
-            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            p_t.astype(do.dtype), do_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds_t = p_t * (dp_t - delta)
+        ds_t = p_t * (dp_t - delta_c)
         if dcap_t is not None:
             ds_t = ds_t * dcap_t
         # q is pre-scaled, so ds_t @ q' == (ds_t * scale) @ q == dk exactly
         dk_scr[:] += jax.lax.dot_general(
-            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            ds_t.astype(q.dtype), q_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(is_full == 1)
-    def _():
-        accum(sc_t, masked=False)
+    # lse/delta q-in-lanes rows: ref block (sublanes, bq) -> (1, bq) views
+    lse = lse_ref[:1, :]  # (1, bq)
+    delta = delta_ref[:1, :]  # (1, bq)
 
-    @pl.when(is_full == 0)
-    def _():
-        q_base = work_qt_ref[w] * bq
-        k_base = work_kt_ref[w] * bk
-        accum(
-            jnp.where(
-                _item_mask(meta_ref, w, q_base, k_base, bq, bk,
-                           transposed=True),
-                sc_t, MASK_VALUE,
-            ),
-            masked=True,
-        )
+    if nc == 0:
+        sc_t, dcap_t = score(q)
+
+        @pl.when(is_full == 1)
+        def _():
+            accum(sc_t, dcap_t, lse, delta, do, q, masked=False)
+
+        @pl.when(is_full == 0)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            accum(
+                jnp.where(
+                    _item_mask(meta_ref, w, q_base, k_base, bq, bk,
+                               transposed=True),
+                    sc_t, MASK_VALUE,
+                ),
+                dcap_t, lse, delta, do, q,
+                masked=True,
+            )
+    else:
+        # extent-clamped body: q is the LANE dim of s_t here, so partial
+        # tiles chunk the q extent (eq0/eq1) instead of the k extent;
+        # skipped chunks are fully masked -> p_t exactly 0 in the legacy
+        # path, so dropping them does not change dk/dv
+        cq = bq // nc
+        eq0, eq1, _, _, live = _item_extents(meta_ref, w)
+
+        @pl.when(is_full == 1)
+        def _():
+            sc_t, dcap_t = score(q)
+            accum(sc_t, dcap_t, lse, delta, do, q, masked=False)
+
+        for c in range(nc):
+            c0 = c * cq
+
+            @pl.when((is_full == 0) & live & (eq0 < c0 + cq) & (eq1 > c0))
+            def _(c0=c0):
+                q_base = work_qt_ref[w] * bq
+                k_base = work_kt_ref[w] * bk
+                q_c = q[c0 : c0 + cq]
+                sc_t, dcap_t = score(q_c)
+                accum(
+                    jnp.where(
+                        _item_mask(meta_ref, w, q_base + c0, k_base, cq,
+                                   bk, transposed=True),
+                        sc_t, MASK_VALUE,
+                    ),
+                    dcap_t,
+                    lse_ref[:1, c0 : c0 + cq],
+                    delta_ref[:1, c0 : c0 + cq],
+                    do[c0 : c0 + cq],
+                    q_c,
+                    masked=True,
+                )
 
     @pl.when((is_last == 1) & (gi == group - 1))
     def _():
@@ -1162,7 +1405,7 @@ def _ffa_bwd_dkv_pallas(
     )
     kernel = partial(
         _bwd_dkv_kernel, softcap=params.softcap,
-        bq=bq, bk=bk, group=g,
+        bq=bq, bk=bk, group=g, nc=_clamp_chunks(bq),
     )
     dk_t, dv_t = pl.pallas_call(
         kernel,
@@ -1202,6 +1445,7 @@ def _bwd_dkv_kernel_gqa(
     bq: int,
     bk: int,
     g: int,
+    clamp: bool,
 ):
     """GQA-packed dk/dv: grid (hk, WT) — the whole query group of one kv
     head per grid step (vs :func:`_bwd_dkv_kernel`'s (hk, WT, g) with the
@@ -1233,24 +1477,26 @@ def _bwd_dkv_kernel_gqa(
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0].reshape(g * bq, dv)
-    # s_t: (bk, g*bq) — k rows, packed (head, q-row) cols
-    s_t = jax.lax.dot_general(
-        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    if softcap > 0.0:
-        sc_t = softcap * jnp.tanh(s_t / softcap)
-        dcap_t = 1.0 - (sc_t / softcap) ** 2
-    else:
-        sc_t = s_t
-        dcap_t = None
 
     lse = lse_ref[...]  # (1, g*bq), tile-packed cols; broadcasts over bk rows
     delta = delta_ref[...]
-    dp_t = jax.lax.dot_general(
-        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
 
-    def accum(sm_t, masked: bool):
+    def score():
+        # s_t: (bk, g*bq) — k rows, packed (head, q-row) cols
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if softcap > 0.0:
+            sc_t = softcap * jnp.tanh(s_t / softcap)
+            return sc_t, 1.0 - (sc_t / softcap) ** 2
+        return s_t, None
+
+    def accum(sm_t, dcap_t, masked: bool):
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         if masked:
             neg = lse <= EMPTY_THRESH
             lse_safe = jnp.where(neg, 0.0, lse)
@@ -1274,22 +1520,52 @@ def _bwd_dkv_kernel_gqa(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(is_full == 1)
-    def _():
-        accum(sc_t, masked=False)
+    if not clamp:
+        sc_t, dcap_t = score()
 
-    @pl.when(is_full == 0)
-    def _():
-        q_base = work_qt_ref[w] * bq
-        k_base = work_kt_ref[w] * bk
-        accum(
-            jnp.where(
-                _item_mask(meta_ref, w, q_base, k_base, bq, bk,
-                           transposed=True, repeat=g),
-                sc_t, MASK_VALUE,
-            ),
-            masked=True,
-        )
+        @pl.when(is_full == 1)
+        def _():
+            accum(sc_t, dcap_t, masked=False)
+
+        @pl.when(is_full == 0)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            accum(
+                jnp.where(
+                    _item_mask(meta_ref, w, q_base, k_base, bq, bk,
+                               transposed=True, repeat=g),
+                    sc_t, MASK_VALUE,
+                ),
+                dcap_t,
+                masked=True,
+            )
+    else:
+        # the packed lane dim interleaves the g heads' q rows, so it cannot
+        # be chunked by a single q extent; clamping here is the whole-item
+        # guard — dummy/pad items (empty extent) skip both MXU passes
+        # (their legacy contribution was exactly 0: masked p_t underflows)
+        _, _, _, _, live = _item_extents(meta_ref, w)
+
+        @pl.when((is_full == 1) & live)
+        def _():
+            sc_t, dcap_t = score()
+            accum(sc_t, dcap_t, masked=False)
+
+        @pl.when((is_full == 0) & live)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            sc_t, dcap_t = score()
+            accum(
+                jnp.where(
+                    _item_mask(meta_ref, w, q_base, k_base, bq, bk,
+                               transposed=True, repeat=g),
+                    sc_t, MASK_VALUE,
+                ),
+                dcap_t,
+                masked=True,
+            )
 
     @pl.when(is_last == 1)
     def _():
@@ -1354,6 +1630,7 @@ def _ffa_bwd_dkv_pallas_gqa(
     )
     kernel = partial(
         _bwd_dkv_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g,
+        clamp=env_kernel.ffa_extent_clamp(),
     )
     dk_t, dv_t = pl.pallas_call(
         kernel,
@@ -1721,6 +1998,148 @@ def default_blocks(sq: int, sk: int, block_q=None, block_k=None) -> tuple[int, i
     return min(bq, _round_up(sq, 16)), min(bk, _round_up(sk, 128))
 
 
+# ---------------------------------------------------------------------------
+# mixed-granularity dispatch: coarse-block pass over dense slices + fine-
+# block pass over fragmented slices, merged through the LSE-merge math
+# (tile_policy.choose_mixed_dispatch decides when the split is profitable)
+# ---------------------------------------------------------------------------
+
+
+def _merge_out_lse(o1, l1, o2, l2):
+    """Exact two-way online-softmax merge of (out, lse) pairs, seq-major.
+
+    Same math as functional/utils.py's lse merge (reimplemented locally:
+    functional imports this module, so importing it here would cycle). lse
+    is natural-log with -inf on uncovered rows. Because the two passes
+    partition the slice set, merged out == sum_i exp(lse_i - lse) * out_i
+    and merged lse == log(sum_i exp(lse_i)) — the single-pass results up
+    to fp roundoff."""
+    m = jnp.maximum(l1, l2)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w1 = jnp.where(jnp.isneginf(l1), 0.0, jnp.exp(l1 - m_safe))
+    w2 = jnp.where(jnp.isneginf(l2), 0.0, jnp.exp(l2 - m_safe))
+    s = w1 + w2
+    covered = s > 0.0
+    lse = jnp.where(
+        covered, m_safe + jnp.log(jnp.where(covered, s, 1.0)), NEG_INF
+    )
+    s_safe = jnp.where(covered, s, 1.0)[..., None]
+    out = (
+        o1.astype(jnp.float32) * w1[..., None]
+        + o2.astype(jnp.float32) * w2[..., None]
+    ) / s_safe
+    return out.astype(o1.dtype), lse
+
+
+def _mixed_branch_fwd(q, k, v, arrays, params: FFAParams):
+    """One forward pass of the mixed dispatch: pad/transpose to the branch's
+    padded geometry, run the fwd kernel, slice back to seq-major."""
+    sq = q.shape[0]
+    sk = k.shape[0]
+    sqp = params.num_q_tiles * params.block_q
+    skp = params.num_k_tiles * params.block_k
+    q_t = jnp.pad(q, ((0, sqp - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
+    k_t = jnp.pad(k, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
+    v_t = jnp.pad(v, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
+    out_t, lse_t, _ = ffa_fwd_pallas_dispatch(
+        params, *arrays[0:3], q_t,
+        k_t.astype(q_t.dtype), v_t.astype(q_t.dtype),
+    )
+    return out_t.transpose(1, 0, 2)[:sq], lse_t.T[:sq]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _ffa_mixed(q, k, v, arrays_a, arrays_b, params_a: FFAParams,
+               params_b: FFAParams):
+    # A dedicated custom_vjp at the merged level is mandatory: the branch
+    # cores ignore their lse cotangents (see _ffa_core_bwd), so naive
+    # autodiff THROUGH the lse merge would drop the coupling between the
+    # branches' softmax normalizers and return wrong branch gradients.
+    o1, l1 = _mixed_branch_fwd(q, k, v, arrays_a, params_a)
+    o2, l2 = _mixed_branch_fwd(q, k, v, arrays_b, params_b)
+    return _merge_out_lse(o1, l1, o2, l2)
+
+
+def _ffa_mixed_fwd(q, k, v, arrays_a, arrays_b, params_a, params_b):
+    out, lse = _ffa_mixed(q, k, v, arrays_a, arrays_b, params_a, params_b)
+    return (out, lse), (q, k, v, out, lse, arrays_a, arrays_b)
+
+
+def _ffa_mixed_bwd(params_a: FFAParams, params_b: FFAParams, res, cts):
+    # Each branch kernel receives the MERGED lse/delta: p = exp(s - lse)
+    # then is the GLOBAL softmax probability of every entry the branch's
+    # slices cover, and since the branches partition the mask the summed
+    # branch gradients equal the single-pass gradients exactly. The lse
+    # cotangent is ignored (same contract as _ffa_core_bwd).
+    do, _ = cts
+    q, k, v, out, lse, arrays_a, arrays_b = res
+    sq, sk = q.shape[0], k.shape[0]
+    do = do.astype(q.dtype)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (sq, hq)
+
+    def branch(arrays, params: FFAParams):
+        sqp = params.num_q_tiles * params.block_q
+        skp = params.num_k_tiles * params.block_k
+        q_t = jnp.pad(q, ((0, sqp - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
+        k_t = jnp.pad(k, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
+        v_t = jnp.pad(v, ((0, skp - sk), (0, 0), (0, 0))).transpose(1, 0, 2)
+        kc, vc = k_t.astype(q_t.dtype), v_t.astype(q_t.dtype)
+        do_t = jnp.pad(do, ((0, sqp - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
+        # padded q rows are uncovered: pad the merged lse with -inf (the
+        # dispatch clamps it to MASK_VALUE, making p exactly 0 there) —
+        # padding with 0 would fabricate probabilities exp(s - 0)
+        lse_t = jnp.pad(
+            lse, ((0, sqp - sq), (0, 0)), constant_values=NEG_INF
+        ).T
+        delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
+        dq_arrays, dkv_arrays = _bwd_plan_slices(arrays)
+        dq_t = ffa_bwd_dq_pallas_dispatch(
+            params, *dq_arrays, q_t, kc, vc, do_t, lse_t, delta_t
+        )
+        dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
+            params, *dkv_arrays, q_t, kc, vc, do_t, lse_t, delta_t
+        )
+        return (
+            dq_t.transpose(1, 0, 2)[:sq],
+            dk_t.transpose(1, 0, 2)[:sk],
+            dv_t.transpose(1, 0, 2)[:sk],
+        )
+
+    dq1, dk1, dv1 = branch(arrays_a, params_a)
+    dq2, dk2, dv2 = branch(arrays_b, params_b)
+    return (
+        (dq1 + dq2).astype(q.dtype),
+        (dk1 + dk2).astype(k.dtype),
+        (dv1 + dv2).astype(v.dtype),
+        tuple(None for _ in arrays_a),
+        tuple(None for _ in arrays_b),
+    )
+
+
+_ffa_mixed.defvjp(_ffa_mixed_fwd, _ffa_mixed_bwd)
+
+
+def _mixed_params(
+    plan: FFAPlan, softmax_scale: float, softcap: float, group: int
+) -> FFAParams:
+    """Branch params for the mixed dispatch: plain 6-array plans, no bwd
+    overrides, no max-logits (the dispatch gate excludes that path)."""
+    return FFAParams(
+        num_work=plan.num_work,
+        num_work_t=plan.num_work_t,
+        num_q_tiles=plan.num_q_tiles,
+        num_k_tiles=plan.num_k_tiles,
+        block_q=plan.block_q,
+        block_k=plan.block_k,
+        softmax_scale=softmax_scale,
+        softcap=softcap,
+        group=group,
+        interpret=_should_interpret(),
+    )
+
+
 def ffa_attn(
     q: jax.Array,
     k: jax.Array,
@@ -1767,6 +2186,43 @@ def ffa_attn(
     sk, hk, dv = v.shape
     if softmax_scale is None:
         softmax_scale = float(d) ** -0.5
+    if (
+        not return_max_logits
+        and block_q is None
+        and block_k is None
+        and not env_kernel.ffa_blocks_pinned()
+    ):
+        # mixed-granularity dispatch: when the cost model (or an explicit
+        # MAGI_ATTENTION_FFA_MIXED_BLOCKS=1) says a coarse/fine split wins,
+        # run two plans and merge — only reachable when blocks are not
+        # pinned (explicit settings always win) and max-logits is off (the
+        # merge does not combine per-head maxima)
+        from .tile_policy import choose_mixed_dispatch
+
+        mix = choose_mixed_dispatch(
+            qr, kr, d_lo, d_hi, sq, sk, d, dv,
+            itemsize=q.dtype.itemsize,
+            coarse_blocks=default_blocks(sq, sk),
+        )
+        if mix is not None:
+            di, fi = mix.dense_idx, mix.frag_idx
+            plan_a = get_ffa_plan(
+                qr[di], kr[di], d_lo[di], d_hi[di], sq, sk,
+                *mix.coarse_blocks,
+            )
+            plan_b = get_ffa_plan(
+                qr[fi], kr[fi], d_lo[fi], d_hi[fi], sq, sk,
+                *mix.fine_blocks,
+            )
+            return _ffa_mixed(
+                q, k, v, plan_arrays(plan_a), plan_arrays(plan_b),
+                _mixed_params(
+                    plan_a, float(softmax_scale), float(softcap), hq // hk
+                ),
+                _mixed_params(
+                    plan_b, float(softmax_scale), float(softcap), hq // hk
+                ),
+            )
     policy_dq = policy_dkv = None
     if block_q is None and block_k is None and not env_kernel.ffa_blocks_pinned():
         from .tile_policy import auto_tile_enabled, choose_blocks_per_pass

@@ -24,9 +24,18 @@ from .mask_utils import BAND_INF
 from .. import telemetry
 from ..utils.profiling import instrument_host
 
-# meta columns per work item
+# meta columns per work item. The first 9 are the band/run columns the
+# native (C) builder also fills; EQ0..EK1 are the tile-LOCAL live-extent
+# columns appended host-side by :func:`_extend_meta_extents` — the exact
+# sub-rectangle of the tile the band touches, rounded out to the hardware
+# quanta, consumed by the extent-clamped kernel bodies (kernels/ffa.py).
 QS, QE, KS, KE, DLO, DHI, IS_FIRST, IS_LAST, IS_FULL = range(9)
-META_DIM = 9
+EQ0, EQ1, EK0, EK1 = 9, 10, 11, 12
+META_DIM = 13
+# rounding quanta for the live extents: q rows land in the sublane dim
+# (fp32 register tiling), k cols in the lane dim
+SUBLANE_QUANTUM = 8
+LANE_QUANTUM = 128
 
 
 @dataclass(frozen=True, eq=False)
@@ -55,6 +64,102 @@ class FFAPlan:
         return len(self.work_qt_t)
 
 
+def _extend_meta_extents(
+    meta9: np.ndarray,
+    work_qt: np.ndarray,
+    work_kt: np.ndarray,
+    block_q: int,
+    block_k: int,
+) -> np.ndarray:
+    """Append the tile-local live-extent columns EQ0..EK1 to 9-col meta rows.
+
+    For each work item the band ``d_lo <= j - i <= d_hi`` restricted to the
+    slice rectangle intersected with the tile gives a live sub-rectangle;
+    its q rows are floored/ceiled to SUBLANE_QUANTUM, its k cols to
+    LANE_QUANTUM (the granularities a kernel chunk can actually skip at).
+    Items with an empty intersection — dummy items for empty tiles, and
+    ``pad_plan`` filler — get the all-zero extent (0, 0, 0, 0), which the
+    clamp path reads as "no live work". Full tiles come out as
+    (0, block_q, 0, block_k) by construction. int64 internally: DLO/DHI
+    carry ±BAND_INF and the un-clamped interval arithmetic must not wrap.
+    """
+    m = meta9.astype(np.int64)
+    qb = work_qt.astype(np.int64) * block_q
+    kb = work_kt.astype(np.int64) * block_k
+    i0 = np.maximum(m[:, QS], qb)
+    i1 = np.minimum(m[:, QE], qb + block_q)
+    j0 = np.maximum(m[:, KS], kb)
+    j1 = np.minimum(m[:, KE], kb + block_k)
+    lo, hi = m[:, DLO], m[:, DHI]
+    # band-live rows/cols inside the clipped rectangle: row i is live iff
+    # some col j in [j0, j1) has lo <= j - i <= hi, and vice versa
+    q0 = np.maximum(i0, j0 - hi)
+    q1 = np.minimum(i1, j1 - lo)
+    k0 = np.maximum(j0, i0 + lo)
+    k1 = np.minimum(j1, i1 + hi)
+    eq0 = (q0 - qb) // SUBLANE_QUANTUM * SUBLANE_QUANTUM
+    eq1 = -(-(q1 - qb) // SUBLANE_QUANTUM) * SUBLANE_QUANTUM
+    ek0 = (k0 - kb) // LANE_QUANTUM * LANE_QUANTUM
+    ek1 = -(-(k1 - kb) // LANE_QUANTUM) * LANE_QUANTUM
+    ext = np.stack(
+        [
+            np.clip(eq0, 0, block_q),
+            np.clip(eq1, 0, block_q),
+            np.clip(ek0, 0, block_k),
+            np.clip(ek1, 0, block_k),
+        ],
+        axis=1,
+    )
+    empty = (i0 >= i1) | (j0 >= j1) | (q1 <= q0) | (k1 <= k0)
+    ext[empty] = 0
+    return np.concatenate([meta9, ext.astype(np.int32)], axis=1)
+
+
+def plan_extent_stats(plan: FFAPlan) -> dict:
+    """Executed-vs-padded element accounting from the extent columns.
+
+    Real items are rows with a non-empty q range (QE > QS) — dummy items
+    for empty tiles and ``pad_plan`` filler carry QS == QE == 0 and are
+    excluded from both counts (CP-stacking filler is not real work)."""
+    meta = plan.meta.astype(np.int64)
+    real = meta[:, QE] > meta[:, QS]
+    n_real = int(real.sum())
+    executed = int(
+        (
+            (meta[real, EQ1] - meta[real, EQ0])
+            * (meta[real, EK1] - meta[real, EK0])
+        ).sum()
+    )
+    return {
+        "num_real_work": n_real,
+        "padded_elems": n_real * plan.block_q * plan.block_k,
+        "executed_elems": executed,
+    }
+
+
+# per-slice padded/band cover-ratio buckets for the fragmentation histogram
+FRAG_BUCKETS: tuple[tuple[str, float], ...] = (
+    ("lt_1.2", 1.2),
+    ("lt_2", 2.0),
+    ("lt_4", 4.0),
+    ("lt_8", 8.0),
+    ("ge_8", float("inf")),
+)
+
+
+def fragmentation_histogram(ratios: np.ndarray) -> dict[str, int]:
+    """Bucket per-slice cover ratios (tile-cover elems / band elems) into
+    the FRAG_BUCKETS histogram the telemetry record and the mixed-dispatch
+    cost model share."""
+    hist = {name: 0 for name, _ in FRAG_BUCKETS}
+    for r in np.asarray(ratios, dtype=np.float64).ravel():
+        for name, ub in FRAG_BUCKETS:
+            if r < ub:
+                hist[name] += 1
+                break
+    return hist
+
+
 def _record_plan_telemetry(
     plan: FFAPlan,
     qr: np.ndarray,
@@ -62,13 +167,22 @@ def _record_plan_telemetry(
     d_lo: np.ndarray,
     d_hi: np.ndarray,
 ) -> FFAPlan:
-    """Gated per-build record: the padded grid work the kernel will execute
-    vs the true band area it needed — the estimated-vs-executed FLOP ratio
-    at plan time (multiply elems by 4 * head_dim * num_heads_q for fwd
-    FLOPs; the step record does, once dims are known)."""
+    """Gated per-build record: the padded grid work the kernel would execute
+    un-clamped, the post-clamp executed elements (live extents), and the
+    true band area it needed — the estimated-vs-executed FLOP ratio at plan
+    time (multiply elems by 4 * head_dim * num_heads_q for fwd FLOPs; the
+    step record does, once dims are known)."""
     if telemetry.enabled():
-        padded = plan.num_work * plan.block_q * plan.block_k
+        from ..env.kernel import ffa_extent_clamp
+        from .tile_policy import slice_cover_ratios
+
+        stats = plan_extent_stats(plan)
+        padded = stats["padded_elems"]
+        executed = stats["executed_elems"]
         band = telemetry.band_area(qr, kr, d_lo, d_hi)
+        ratios = slice_cover_ratios(
+            qr, kr, d_lo, d_hi, plan.block_q, plan.block_k
+        )
         telemetry.record_event(
             "ffa_plan",
             num_slices=len(qr),
@@ -80,7 +194,11 @@ def _record_plan_telemetry(
             num_work_t=plan.num_work_t,
             padded_elems=padded,
             band_elems=band,
+            executed_elems=executed,
             padding_ratio=padded / band if band else 1.0,
+            executed_ratio=executed / band if band else 1.0,
+            extent_clamp=ffa_extent_clamp(),
+            frag_histogram=fragmentation_histogram(ratios),
         )
     return plan
 
@@ -141,11 +259,19 @@ def build_ffa_plan(
                 q_ranges, k_ranges, d_lo, d_hi,
                 num_q_tiles, num_k_tiles, block_q, block_k, BAND_INF,
             )
+            # the C fill writes 9-col rows (fixed stride, csrc/magi_host.cpp);
+            # the extent columns are appended here so native and Python
+            # plans stay bit-identical
             return _record_plan_telemetry(
                 FFAPlan(
-                    work_qt=arrays[0], work_kt=arrays[1], meta=arrays[2],
+                    work_qt=arrays[0], work_kt=arrays[1],
+                    meta=_extend_meta_extents(
+                        arrays[2], arrays[0], arrays[1], block_q, block_k
+                    ),
                     work_qt_t=arrays[3], work_kt_t=arrays[4],
-                    meta_t=arrays[5],
+                    meta_t=_extend_meta_extents(
+                        arrays[5], arrays[3], arrays[4], block_q, block_k
+                    ),
                     num_q_tiles=num_q_tiles, num_k_tiles=num_k_tiles,
                     block_q=block_q, block_k=block_k,
                 ),
@@ -213,7 +339,7 @@ def build_ffa_plan(
                     )
                 ]
             for pos, (qt, kt, qs, qe, ks, ke, lo, hi, full) in enumerate(items):
-                m = np.zeros(META_DIM, dtype=np.int32)
+                m = np.zeros(9, dtype=np.int32)
                 m[QS], m[QE], m[KS], m[KE] = qs, qe, ks, ke
                 m[DLO], m[DHI] = lo, hi
                 m[IS_FIRST] = 1 if pos == 0 else 0
@@ -222,10 +348,13 @@ def build_ffa_plan(
                 work_a.append(qt)
                 work_b.append(kt)
                 metas.append(m)
+        work_a = np.asarray(work_a, dtype=np.int32)
+        work_b = np.asarray(work_b, dtype=np.int32)
+        meta9 = np.stack(metas).astype(np.int32)
         return (
-            np.asarray(work_a, dtype=np.int32),
-            np.asarray(work_b, dtype=np.int32),
-            np.stack(metas).astype(np.int32),
+            work_a,
+            work_b,
+            _extend_meta_extents(meta9, work_a, work_b, block_q, block_k),
         )
 
     work_qt, work_kt, meta = flatten(q_items, major_is_q=True)
@@ -262,6 +391,9 @@ def pad_plan(plan: FFAPlan, num_work: int, num_work_t: int) -> FFAPlan:
         pad_n = target - w
         pa = np.full(pad_n, work_a[-1], dtype=np.int32)
         pb = np.full(pad_n, work_b[-1], dtype=np.int32)
+        # filler rows keep the all-zero live extent (EQ0..EK1 == 0): the
+        # clamp path skips them and plan_extent_stats excludes them from
+        # the padded/executed accounting (QS == QE flags them as non-real)
         pm = np.zeros((pad_n, META_DIM), dtype=np.int32)
         pm[:, DLO], pm[:, DHI] = -BAND_INF, BAND_INF
         return (

@@ -26,6 +26,8 @@ always win, mirroring the reference's env-override contract.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 import numpy as np
 
 from .. import telemetry
@@ -424,3 +426,193 @@ def reachable_block_space(
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Mixed-granularity dispatch: per-slice fragmentation + two-pass plan split.
+#
+# A single (block_q, block_k) choice is a compromise: dense slices amortize
+# per-step overhead best under big tiles, while fragmented slices (block-
+# sparse, video windows) waste most of each big tile on padding. When the
+# gap is large enough, splitting the slice set into a coarse-block dense
+# pass and a fine-block fragmented pass — merged through the standard LSE
+# merge — beats any single tiling. The split is judged by the same exact
+# work counters the tile scorer uses, so the decision cannot drift from
+# what the plans actually cost.
+# ---------------------------------------------------------------------------
+
+# a slice is "fragmented" when its tile cover runs >= 2x its band area
+FRAG_THRESHOLD = 2.0
+# LSE-merge overhead in score-element equivalents: one extra read+combine
+# pass over out/lse rows (VPU) plus the second pass's outputs round-tripping
+# HBM — charged per merged q row at lane granularity
+MERGE_OVERHEAD_PER_ROW = 2 * NUM_LANES
+
+
+def slice_cover_tiles(
+    qr: np.ndarray,
+    kr: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+    block_q: int,
+    block_k: int,
+) -> np.ndarray:
+    """Per-slice count of (q_tile, k_tile) pairs the slice's band touches.
+
+    Per q tile of a slice the intersecting k tiles form one contiguous run
+    (the band's column window of the clipped rows is a single interval),
+    so the cover is closed-form per (slice, q_tile) — same counting core
+    as :func:`count_ffa_work`, kept per-slice instead of summed, and
+    without the one-dummy-per-empty-q-tile floor the grid needs.
+    """
+    n = len(qr)
+    tiles = np.zeros(n, dtype=np.int64)
+    for s in range(n):
+        qs, qe = int(qr[s, 0]), int(qr[s, 1])
+        ks, ke = int(kr[s, 0]), int(kr[s, 1])
+        lo, hi = int(d_lo[s]), int(d_hi[s])
+        if qs >= qe or ks >= ke or lo > hi:
+            continue
+        t = np.arange(qs // block_q, (qe - 1) // block_q + 1, dtype=np.int64)
+        i0 = np.maximum(qs, t * block_q)
+        i1 = np.minimum(qe, (t + 1) * block_q)
+        j0 = np.maximum(ks, i0 + lo)
+        j1 = np.minimum(ke - 1, (i1 - 1) + hi)
+        nonempty = j0 <= j1
+        tiles[s] = int(np.sum((j1 // block_k - j0 // block_k + 1)[nonempty]))
+    return tiles
+
+
+def slice_cover_ratios(
+    qr: np.ndarray,
+    kr: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+    block_q: int,
+    block_k: int,
+) -> np.ndarray:
+    """Per-slice fragmentation ratio: padded tile-cover elements / band
+    elements under this tiling. 1.0 = the tiles fit the band exactly;
+    large values flag slices whose tiles are mostly padding. Empty or
+    degenerate slices get ratio 1.0 (nothing to rescue).
+    """
+    from .. import telemetry as _telemetry
+
+    n = len(qr)
+    tiles = slice_cover_tiles(qr, kr, d_lo, d_hi, block_q, block_k)
+    ratios = np.ones(n, dtype=np.float64)
+    for s in range(n):
+        if tiles[s] <= 0:
+            continue
+        band = _telemetry.band_area(
+            qr[s : s + 1], kr[s : s + 1], d_lo[s : s + 1], d_hi[s : s + 1]
+        )
+        if band <= 0:
+            continue
+        ratios[s] = int(tiles[s]) * block_q * block_k / band
+    return ratios
+
+
+@dataclass(frozen=True)
+class MixedDispatch:
+    """A profitable two-pass split of one slice set."""
+
+    dense_idx: np.ndarray  # slice indices for the coarse-block pass
+    frag_idx: np.ndarray  # slice indices for the fine-block pass
+    coarse_blocks: tuple[int, int]
+    fine_blocks: tuple[int, int]
+    single_score: int  # modeled cost of coarse blocks over ALL slices
+    split_score: int  # modeled cost of the split incl. merge overhead
+
+
+def choose_mixed_dispatch(
+    qr: np.ndarray,
+    kr: np.ndarray,
+    d_lo: np.ndarray,
+    d_hi: np.ndarray,
+    sq: int,
+    sk: int,
+    d: int = 128,
+    dv: int = 128,
+    itemsize: int = 2,
+    coarse_blocks: tuple[int, int] | None = None,
+) -> MixedDispatch | None:
+    """Decide whether to split the slice set into a coarse-block dense pass
+    plus a fine-block fragmented pass (merged via LSE merge), or run one
+    plan as usual (None).
+
+    Gated by ``MAGI_ATTENTION_FFA_MIXED_BLOCKS``: "0" never splits, "1"
+    splits whenever a non-trivial partition with distinct tilings exists,
+    "auto" (default) additionally requires the cost model to favor the
+    split: score(coarse on dense) + score(fine on fragmented) + merge
+    overhead < score(coarse on everything), with score the same
+    padded-work + per-step-overhead model the tile scorer minimizes.
+    """
+    from ..env.kernel import ffa_mixed_blocks
+
+    mode = ffa_mixed_blocks()
+    if mode == "0" or len(qr) < 2:
+        return None
+    coarse = coarse_blocks or (
+        min(256, _round_up(sq, 16)), min(512, _round_up(sk, NUM_LANES))
+    )
+    ratios = slice_cover_ratios(qr, kr, d_lo, d_hi, coarse[0], coarse[1])
+    frag = ratios >= FRAG_THRESHOLD
+    frag_idx = np.nonzero(frag)[0]
+    dense_idx = np.nonzero(~frag)[0]
+    if len(frag_idx) == 0 or len(dense_idx) == 0:
+        return None
+    fi = frag_idx
+    fine = choose_blocks(
+        qr[fi], kr[fi], d_lo[fi], d_hi[fi], sq, sk, d, dv, itemsize
+    )
+    if fine == coarse:
+        return None
+
+    def score(idx: np.ndarray, blocks: tuple[int, int]) -> int:
+        # grid steps (incl. one dummy per empty q tile) pay fixed overhead;
+        # only band-touching tiles pay compute — with extent clamping on,
+        # dummy items skip their dots entirely, so charging them a full
+        # bq*bk tile would bias auto mode against fine-block passes
+        w = count_ffa_work(
+            qr[idx], kr[idx], d_lo[idx], d_hi[idx],
+            sq, sk, blocks[0], blocks[1],
+        )
+        tiles = int(
+            slice_cover_tiles(
+                qr[idx], kr[idx], d_lo[idx], d_hi[idx], blocks[0], blocks[1]
+            ).sum()
+        )
+        return tiles * blocks[0] * blocks[1] + w * OVERHEAD_ELEMS
+
+    all_idx = np.arange(len(qr))
+    single = score(all_idx, coarse)
+    split = (
+        score(dense_idx, coarse)
+        + score(frag_idx, fine)
+        + sq * MERGE_OVERHEAD_PER_ROW
+    )
+    profitable = split < single
+    if mode != "1" and not profitable:
+        return None
+    result = MixedDispatch(
+        dense_idx=dense_idx,
+        frag_idx=frag_idx,
+        coarse_blocks=coarse,
+        fine_blocks=fine,
+        single_score=single,
+        split_score=split,
+    )
+    if telemetry.enabled():
+        telemetry.record_event(
+            "mixed_dispatch",
+            num_slices=len(qr),
+            num_dense=len(dense_idx),
+            num_frag=len(frag_idx),
+            coarse_blocks=list(coarse),
+            fine_blocks=list(fine),
+            single_score=single,
+            split_score=split,
+            forced=mode == "1" and not profitable,
+        )
+    return result

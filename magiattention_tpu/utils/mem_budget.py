@@ -137,6 +137,6 @@ def ffa_max_total_seqlen(
     """Upper bound on the merged kv length whose *index metadata* fits the
     scalar-prefetch budget (the payload streams from HBM, so the real bound
     is plan size, not seqlen)."""
-    per_item = 9 * 4 + 2 * 4  # meta row + two work indices
+    per_item = 13 * 4 + 2 * 4  # meta row (9 band + 4 extent cols) + two work indices
     max_items = max(1, vmem_bytes // (8 * per_item))
     return max_items * block_k

@@ -130,11 +130,34 @@ def aggregate(records: list[dict]) -> dict:
     if ffa:
         padded = sum(r.get("padded_elems", 0) for r in ffa)
         band = sum(r.get("band_elems", 0) for r in ffa)
+        executed = sum(r.get("executed_elems", 0) for r in ffa)
+        frag_hist: dict[str, int] = {}
+        for r in ffa:
+            for bucket, n in (r.get("frag_histogram") or {}).items():
+                frag_hist[bucket] = frag_hist.get(bucket, 0) + n
         agg["ffa_plans"] = {
             "plans": len(ffa),
             "padded_elems": padded,
             "band_elems": band,
+            "executed_elems": executed,
             "padding_ratio": padded / band if band else None,
+            "executed_ratio": executed / band if band else None,
+            "extent_clamp": ffa[-1].get("extent_clamp"),
+            "frag_histogram": frag_hist or None,
+        }
+
+    mixed = kinds.get("mixed_dispatch", [])
+    if mixed:
+        last = mixed[-1]
+        agg["mixed_dispatch"] = {
+            "splits": len(mixed),
+            "forced": sum(1 for r in mixed if r.get("forced")),
+            "num_dense": last.get("num_dense"),
+            "num_frag": last.get("num_frag"),
+            "coarse_blocks": last.get("coarse_blocks"),
+            "fine_blocks": last.get("fine_blocks"),
+            "single_score": last.get("single_score"),
+            "split_score": last.get("split_score"),
         }
 
     tiles = kinds.get("tile_policy", [])
@@ -311,6 +334,32 @@ def format_summary(agg: dict) -> str:
             f"ffa plans={fp['plans']} band_elems={fp['band_elems']} "
             f"padded_elems={fp['padded_elems']}"
             + (f" (padding_ratio={ratio:.3f})" if ratio else "")
+        )
+        if fp.get("executed_ratio") is not None:
+            clamp = fp.get("extent_clamp")
+            lines.append(
+                f"  extent clamp[{'on' if clamp else 'off'}]: "
+                f"executed_elems={fp['executed_elems']} "
+                f"(executed/band={fp['executed_ratio']:.3f} vs "
+                f"padded/band={ratio:.3f})"
+                if ratio is not None
+                else f"  executed_elems={fp['executed_elems']}"
+            )
+        if fp.get("frag_histogram"):
+            hist = " ".join(
+                f"{k}={v}" for k, v in fp["frag_histogram"].items()
+            )
+            lines.append(f"  fragmentation (slices by cover ratio): {hist}")
+
+    md = agg.get("mixed_dispatch")
+    if md:
+        lines.append("")
+        lines.append(
+            f"mixed dispatch splits={md['splits']} "
+            f"(forced={md['forced']}): last "
+            f"dense={md['num_dense']} slices @ {md['coarse_blocks']} + "
+            f"frag={md['num_frag']} slices @ {md['fine_blocks']} "
+            f"(score {md['single_score']} -> {md['split_score']})"
         )
 
     tp = agg.get("tile_policy")

@@ -7,6 +7,13 @@ the second half of ``make analysis`` (the first is the AST linter).
 The corpus mirrors tests/test_solver/golden_plan_lib.py's canonical masks
 (the regression proof for ISSUE satellite 1: the shipped solvers produce
 R1-R5-clean plans across the whole grid).
+
+A second sweep verifies direct FFA kernel plans (no CP solver): the
+live-extent meta columns (R5 extent half, verifier.check_plan_extents)
+over fragmented sparse masks + canonical bands, plus the extent-clamp
+regression gate — on fragmented golden plans the post-clamp executed/band
+ratio must stay <= 1.5 and sit >= 3x below the un-clamped padded/band
+ratio. ``--skip-ffa`` disables that sweep.
 """
 
 from __future__ import annotations
@@ -18,7 +25,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from magiattention_tpu.analysis import verify_dynamic_plan, verify_plan  # noqa: E402
-from magiattention_tpu.analysis.verifier import check_tiles
+from magiattention_tpu.analysis.verifier import check_plan_extents, check_tiles
 from magiattention_tpu.common.enum import AttnMaskType
 from magiattention_tpu.common.ranges import AttnRanges
 from magiattention_tpu.config import DistAttnConfig, OverlapConfig
@@ -94,6 +101,103 @@ def _verify_static(name: str, cp: int, degree: int, verbose: bool) -> int:
     return _report(f"{name}/cp{cp}/ov{degree}", report, verbose)
 
 
+def ffa_golden_plans() -> list[tuple]:
+    """(label, qr, kr, d_lo, d_hi, sq, sk, blocks, gated) — direct FFA
+    kernel plans (no CP solver in the loop) over fragmented sparse masks
+    plus canonical bands, at the coarse default tiling and the fine tiling
+    the mixed dispatch's fragmented branch uses. ``gated`` rows are the
+    fragmented ones the clamp regression gate asserts over."""
+    import numpy as np
+
+    from magiattention_tpu.analysis.kernel_check import _fragmented_masks
+    from magiattention_tpu.kernels.mask_utils import BAND_INF, types_to_bands
+
+    s = 2048
+    plans: list[tuple] = []
+    for mask_name, (qr, kr, lo, hi) in _fragmented_masks(s).items():
+        for blocks in ((256, 512), (128, 128)):
+            plans.append(
+                (
+                    f"ffa/{mask_name}/b{blocks[0]}x{blocks[1]}",
+                    qr, kr, lo, hi, s, s, blocks,
+                    blocks == (256, 512),
+                )
+            )
+    # canonical bands at the default tiling: exercises full tiles
+    # (extent == whole tile) and the sliding-window diagonal extents
+    qr = np.asarray([[0, s]], np.int32)
+    causal_lo, causal_hi = types_to_bands(qr, qr, np.asarray([1], np.int32))
+    plans.append(
+        ("ffa/causal/b256x512", qr, qr.copy(), causal_lo, causal_hi,
+         s, s, (256, 512), False)
+    )
+    plans.append(
+        ("ffa/sliding_window/b256x512", qr, qr.copy(),
+         np.asarray([-256], np.int32), np.asarray([0], np.int32),
+         s, s, (256, 512), False)
+    )
+    # ragged seqlen: the last tile is mostly padding, extents must clip
+    rs = s - s // 8
+    rqr = np.asarray([[0, rs]], np.int32)
+    plans.append(
+        ("ffa/causal_ragged/b256x512", rqr, rqr.copy(),
+         types_to_bands(rqr, rqr, np.asarray([1], np.int32))[0],
+         np.asarray([0], np.int32), rs, rs, (256, 512), False)
+    )
+    # degenerate: empty slice rows must come out with all-zero extents
+    eqr = np.asarray([[0, s], [512, 512]], np.int32)
+    ekr = np.asarray([[0, s], [0, 0]], np.int32)
+    plans.append(
+        ("ffa/with_empty_slice/b256x512", eqr, ekr,
+         np.asarray([-BAND_INF, -BAND_INF], np.int32),
+         np.asarray([BAND_INF, BAND_INF], np.int32),
+         s, s, (256, 512), False)
+    )
+    return plans
+
+
+# post-clamp executed/band ceiling on fragmented golden plans, and the
+# minimum factor by which the un-clamped padded/band ratio must exceed it
+# (the ISSUE acceptance: >= 3x drop in executed work on fragmented masks)
+EXECUTED_BAND_CEILING = 1.5
+MIN_CLAMP_DROP = 3.0
+
+
+def _verify_ffa_plan(row: tuple, verbose: bool) -> int:
+    from magiattention_tpu import telemetry
+    from magiattention_tpu.analysis.violation import ERROR, VerifyReport
+    from magiattention_tpu.kernels.ffa_plan import (
+        get_ffa_plan,
+        plan_extent_stats,
+    )
+
+    label, qr, kr, lo, hi, sq, sk, blocks, gated = row
+    plan = get_ffa_plan(qr, kr, lo, hi, sq, sk, *blocks)
+    report = VerifyReport()
+    check_plan_extents(report, plan)
+    check_tiles(report, blocks, sq, sk)
+    stats = plan_extent_stats(plan)
+    band = telemetry.band_area(qr, kr, lo, hi)
+    if gated and band > 0:
+        executed_ratio = stats["executed_elems"] / band
+        padded_ratio = stats["padded_elems"] / band
+        if executed_ratio > EXECUTED_BAND_CEILING:
+            report.add(
+                "R5", ERROR, label,
+                f"post-clamp executed/band ratio {executed_ratio:.2f} "
+                f"exceeds the {EXECUTED_BAND_CEILING} regression ceiling "
+                "on a fragmented golden plan",
+            )
+        if padded_ratio < MIN_CLAMP_DROP * executed_ratio:
+            report.add(
+                "R5", ERROR, label,
+                f"extent clamping only buys {padded_ratio:.2f}x -> "
+                f"{executed_ratio:.2f}x of band work; the gate requires "
+                f"a >= {MIN_CLAMP_DROP}x drop on fragmented plans",
+            )
+    return _report(label, report, verbose)
+
+
 def _verify_dynamic(name: str, cp: int, verbose: bool) -> int:
     from magiattention_tpu.meta._make_attn_meta import make_dynamic_attn_plan
 
@@ -142,6 +246,10 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated mask names (default: all canonical masks)",
     )
     ap.add_argument("--skip-dynamic", action="store_true")
+    ap.add_argument(
+        "--skip-ffa", action="store_true",
+        help="skip the direct FFA kernel-plan sweep (extents + clamp gate)",
+    )
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print warnings")
     args = ap.parse_args(argv)
@@ -164,6 +272,10 @@ def main(argv: list[str] | None = None) -> int:
             if not args.skip_dynamic and cp > 1:
                 total_errors += _verify_dynamic(name, cp, args.verbose)
                 n_plans += 1
+    if not args.skip_ffa:
+        for row in ffa_golden_plans():
+            total_errors += _verify_ffa_plan(row, args.verbose)
+            n_plans += 1
     sys.stdout.write(
         f"verified {n_plans} plan(s): "
         f"{'FAIL' if total_errors else 'all clean'} "

@@ -75,10 +75,11 @@ def test_k5_allowlist_entries_carry_a_proof():
 
 def test_seeded_mutations_fire_exactly_their_rule():
     results = run_seeded_mutations()
-    assert len(results) == 5
+    assert len(results) == 6
     assert {r["expected_rule"] for r in results} == {
         "K1", "K2", "K3", "K4", "K5"
     }
+    assert {r["mutation"] for r in results} >= {"corrupted_extent_row"}
     for r in results:
         assert r["ok"], (
             f"mutation {r['mutation']} expected {{'{r['expected_rule']}'}} "
