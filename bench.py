@@ -552,6 +552,171 @@ def run_sparse_suite() -> int:
 
 
 # ---------------------------------------------------------------------------
+# --bwd-suite: split-vs-fused backward A/B (MAGI_ATTENTION_FFA_FUSED_BWD)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_families(seq: int) -> dict:
+    """name -> (qr, kr, tmap): the fwd+bwd A/B mask families. varlen packs
+    three causal documents of uneven length — the fragmented plan whose
+    partial q-tiles exercise the QVF/QVL revisit flags hardest."""
+    import numpy as np
+
+    one = np.asarray([[0, seq]], np.int32)
+    a, b = seq // 4, 5 * seq // 8
+    vr = np.asarray([[0, a], [a, b], [b, seq]], np.int32)
+    return {
+        "causal": (one, one.copy(), np.asarray([1], np.int32)),
+        "full": (one, one.copy(), np.asarray([0], np.int32)),
+        "varlen": (vr, vr.copy(), np.asarray([1, 1, 1], np.int32)),
+    }
+
+
+def run_bwd_suite() -> int:
+    """Slope-timed split-vs-fused backward A/B per mask family and seqlen.
+
+    Each (family, seq) runs the SAME fwd+bwd grad body under
+    MAGI_ATTENTION_FFA_FUSED_BWD=0 (split dq + dkv passes) and =1 (fused
+    one-pass), with the credibility floor computed from each mode's OWN
+    executed matmul work (fwd 2 tile matmuls + bwd 7 split / 5 fused —
+    a fused slope beating the 5-matmul physics is an under-cancelled
+    pair, not a win). Rows append to benchmarks/history/bench_bwd.csv;
+    off-TPU the suite still runs end-to-end (tiny shape, chained timing,
+    no floor) so the A/B harness itself stays CI-covered."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from magiattention_tpu import telemetry
+    from magiattention_tpu.benchmarking.bench import (
+        do_bench_scan_slope,
+        make_consume_all_grads_body,
+    )
+    from magiattention_tpu.benchmarking.perf_report import credible_floor_ms
+    from magiattention_tpu.kernels.ffa import (
+        FFAParams,
+        _should_interpret,
+        default_blocks,
+        ffa_attn,
+        resolved_bwd_mode,
+    )
+    from magiattention_tpu.kernels.ffa_plan import _cached_plan, get_ffa_plan
+    from magiattention_tpu.kernels.mask_utils import types_to_bands
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    seqs = (4096, 8192, 16384) if on_tpu else (1024,)
+    HQ, HK, D = (16, 8, 128) if on_tpu else (4, 2, 64)
+    dtype = jnp.bfloat16
+
+    # per-tile-matmul flops = 2 * band * d * hq (each of fwd's 2 matmuls
+    # contributes 4*band*d*hq / 2); bwd executes 7 (split) or 5 (fused)
+    BWD_MATMULS = {"split": 7, "fused": 5}
+
+    rows = []
+    for seq in seqs:
+        bq, bk = default_blocks(seq, seq)
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((seq, HQ, D)), dtype)
+        k = jnp.asarray(rng.standard_normal((seq, HK, D)), dtype)
+        v = jnp.asarray(rng.standard_normal((seq, HK, D)), dtype)
+        w = jnp.asarray(rng.standard_normal((seq, HQ, D)), jnp.float32)
+        for name, (qr, kr, tm) in _bwd_families(seq).items():
+            lo, hi = types_to_bands(qr, kr, tm)
+            band = telemetry.band_area(qr, kr, lo, hi)
+            plan = get_ffa_plan(qr, kr, lo, hi, seq, seq, bq, bk)
+            prm = FFAParams(
+                num_work=plan.num_work, num_work_t=plan.num_work_t,
+                num_q_tiles=plan.num_q_tiles,
+                num_k_tiles=plan.num_k_tiles, block_q=bq, block_k=bk,
+                softmax_scale=float(D) ** -0.5, softcap=0.0,
+                group=HQ // HK, interpret=_should_interpret(),
+            )
+            auto_mode = resolved_bwd_mode(
+                prm, plan.num_q_tiles * bq, D, D,
+                jnp.dtype(dtype).itemsize,
+            )
+
+            def make_grad_body():
+                def loss(q, k, v):
+                    o, _ = ffa_attn(q, k, v, qr, kr, tm,
+                                    block_q=bq, block_k=bk)
+                    return jnp.sum(o.astype(jnp.float32) * w)
+
+                grad = jax.grad(loss, argnums=(0, 1, 2))
+                return make_consume_all_grads_body(
+                    lambda q: grad(q, k, v), dtype
+                )
+
+            pair = {}
+            for mode, flag in (("split", "0"), ("fused", "1")):
+                saved = os.environ.get("MAGI_ATTENTION_FFA_FUSED_BWD")
+                os.environ["MAGI_ATTENTION_FFA_FUSED_BWD"] = flag
+                _cached_plan.cache_clear()
+                row = {
+                    "family": name, "seq": seq, "mode": mode,
+                    "auto_mode": auto_mode, "backend": backend,
+                    "block_q": bq, "block_k": bk,
+                    "band_elems": int(band),
+                }
+                # executed matmul flops for THIS mode's floor
+                exec_flops = (
+                    2 * band * D * HQ * (2 + BWD_MATMULS[mode])
+                )
+                try:
+                    if on_tpu:
+                        floor = credible_floor_ms(exec_flops)
+                        ms = do_bench_scan_slope(
+                            make_grad_body(), q, lengths=(8, 32),
+                            reps=2, min_credible_ms=floor,
+                        )
+                        row["floor_ms"] = round(floor, 3)
+                        row["timing_mode"] = "scan_slope"
+                    else:
+                        import time as _time
+
+                        step = jax.jit(make_grad_body())
+                        step(q).block_until_ready()  # compile
+                        t0 = _time.perf_counter()
+                        step(q).block_until_ready()
+                        ms = (_time.perf_counter() - t0) * 1e3
+                        row["timing_mode"] = "chained_cpu"
+                    # reference-convention fwd+bwd rate (fwd + 2.5x bwd)
+                    row["ms"] = round(ms, 3)
+                    row["tflops_ref"] = round(
+                        4 * band * D * HQ * 3.5 / (ms * 1e-3) / 1e12, 3
+                    )
+                    pair[mode] = ms
+                except Exception as e:  # noqa: BLE001
+                    row["error"] = f"{type(e).__name__}: {e}"[:200]
+                finally:
+                    if saved is None:
+                        os.environ.pop(
+                            "MAGI_ATTENTION_FFA_FUSED_BWD", None
+                        )
+                    else:
+                        os.environ["MAGI_ATTENTION_FFA_FUSED_BWD"] = saved
+                    _cached_plan.cache_clear()
+                rows.append(row)
+            if "split" in pair and "fused" in pair and pair["fused"]:
+                rows[-1]["fused_speedup"] = round(
+                    pair["split"] / pair["fused"], 3
+                )
+
+    try:
+        from magiattention_tpu.benchmarking.perf_report import append_row
+
+        for row in rows:
+            append_row("bench_bwd", row)
+    except Exception:
+        pass
+    return _emit(
+        {"metric": "ffa_bwd_suite", "backend": backend, "rows": rows}
+    )
+
+
+# ---------------------------------------------------------------------------
 # parent: subprocess isolation + bounded retry + degraded-output path
 # ---------------------------------------------------------------------------
 
@@ -595,4 +760,6 @@ def main() -> int:
 if __name__ == "__main__":
     if "--sparse-suite" in sys.argv:
         sys.exit(run_sparse_suite())
+    if "--bwd-suite" in sys.argv:
+        sys.exit(run_bwd_suite())
     sys.exit(run_worker() if "--worker" in sys.argv else main())

@@ -253,6 +253,19 @@ class _capture_pallas:
         def recorder(kernel, *, grid_spec=None, out_shape=None, **_kw):
             def runner(*args):
                 gs = grid_spec
+                if gs is None:
+                    # plain-grid pallas_call (grid=/in_specs=/out_specs=
+                    # kwargs, no scalar prefetch or scratch) — the delta
+                    # kernel's shape
+                    from types import SimpleNamespace
+
+                    gs = SimpleNamespace(
+                        grid=tuple(_kw.get("grid", ())),
+                        in_specs=tuple(_kw.get("in_specs", ())),
+                        out_specs=tuple(_kw.get("out_specs", ())),
+                        scratch_shapes=tuple(_kw.get("scratch_shapes", ())),
+                        num_scalar_prefetch=0,
+                    )
                 nsp = int(getattr(gs, "num_scalar_prefetch", 0))
                 kname = getattr(
                     getattr(kernel, "func", kernel), "__name__", str(kernel)
@@ -392,6 +405,7 @@ def capture_ffa_contracts(spec: AuditSpec) -> list[KernelContract]:
     k_t = jnp.zeros((spec.hk, skp, spec.d), dtype)
     v_t = jnp.zeros((spec.hk, skp, spec.dv), dtype)
     do_t = jnp.zeros((spec.hq, sqp, spec.dv), dtype)
+    out_t = jnp.zeros((spec.hq, sqp, spec.dv), dtype)
     lse_t = jnp.zeros((spec.hq, sqp), jnp.float32)
     delta_t = jnp.zeros((spec.hq, sqp), jnp.float32)
 
@@ -406,13 +420,39 @@ def capture_ffa_contracts(spec: AuditSpec) -> list[KernelContract]:
             <= VMEM_ALLOWED_BYTES
         )
 
+    def fused_ok(packed_flag: bool) -> bool:
+        # mirrors ffa.fused_bwd_feasible: the runtime never routes an
+        # over-budget config to the fused kernel, so the audit doesn't
+        # drive one either
+        kbq, kbk = params.dkv_blocks()
+        if packed_flag and (g == 1 or sqp % kbq != 0):
+            return False
+        return (
+            ffa_kernel_residency(
+                "fused", kbq, kbk, spec.d, head_dim_v=spec.dv,
+                dtype_bytes=itemsize, group=g, packed=packed_flag,
+            )
+            <= VMEM_ALLOWED_BYTES
+        )
+
     runs: list[tuple] = [
         (ffa._ffa_fwd_pallas, (params, *arrays[0:3], q_t, k_t, v_t)),
         (ffa._ffa_bwd_dq_pallas,
          (params, *dq_triple, q_t, k_t, v_t, do_t, lse_t, delta_t)),
         (ffa._ffa_bwd_dkv_pallas,
          (params, *dkv_triple, q_t, k_t, v_t, do_t, lse_t, delta_t)),
+        (ffa._ffa_delta_pallas, (out_t, do_t, bq, True)),
     ]
+    if fused_ok(False):
+        runs.append(
+            (ffa._ffa_bwd_fused_pallas,
+             (params, *dkv_triple, q_t, k_t, v_t, do_t, lse_t, delta_t))
+        )
+    if fused_ok(True):
+        runs.append(
+            (ffa._ffa_bwd_fused_pallas_gqa,
+             (params, *dkv_triple, q_t, k_t, v_t, do_t, lse_t, delta_t))
+        )
     if g > 1 and not spec.emit_ml and pack_ok("fwd", bq, bk):
         runs.append(
             (ffa._ffa_fwd_pallas_gqa, (params, *arrays[0:3], q_t, k_t, v_t))
@@ -452,8 +492,21 @@ def _contract_shape_info(contract: KernelContract) -> dict:
     also apply to synthetic/mutated contracts in tests."""
     name = contract.kernel_name
     packed = name.endswith("_gqa")
+    if "delta" in name:
+        # stateless map kernel: in_specs are (o, do), both (1, bq, dv)
+        o_block = contract.in_specs[0].block_shape
+        return dict(
+            kind="delta", packed=False, g=1,
+            bq=int(o_block[1]), bk=0,
+            d=int(o_block[2]), dv=int(o_block[2]),
+            itemsize=np.dtype(contract.operands[0][1]).itemsize,
+            emit_ml=False,
+        )
     kind = (
-        "fwd" if "fwd" in name else "dq" if "dq" in name else "dkv"
+        "fused" if "fused" in name
+        else "fwd" if "fwd" in name
+        else "dq" if "dq" in name
+        else "dkv"
     )
     q_block = contract.in_specs[0].block_shape
     k_block = contract.in_specs[1].block_shape
@@ -537,9 +590,12 @@ def check_k1_vmem(
             f"{VMEM_ALLOWED_BYTES} ({VMEM_LIMIT_BYTES} limit - "
             f"{VMEM_HEADROOM_BYTES} headroom)",
         )
-    if not info["packed"]:
+    if not info["packed"] and info["kind"] in ("fwd", "dq", "dkv"):
         # cross-check against the vmem_check-guarded tile-policy model:
         # the policy filter and mem_budget must be the SAME arithmetic
+        # (fused/delta have no tile_policy block filter — the fused path
+        # reuses the dkv block space and gates on ffa_kernel_residency
+        # directly, so there is no second model to diverge from)
         from ..kernels import tile_policy
 
         est_policy = (
@@ -937,11 +993,42 @@ def check_kernel_sources(
                 "PALLAS_CONTRACTS out of date",
             )
             continue
+        # K4 source half: every MXU contraction accumulates in f32
+        # (runs for every contract, including stateless map kernels)
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and _callee_name(node.func) == "dot_general"
+            ):
+                kw = {k.arg: k.value for k in node.keywords}
+                pet = kw.get("preferred_element_type")
+                if pet is None or not ast.unparse(pet).endswith("float32"):
+                    report.add(
+                        "K4", ERROR, f"{site}:{node.lineno}",
+                        "dot_general without "
+                        "preferred_element_type=jnp.float32 — MXU "
+                        "accumulation falls back to the input dtype",
+                    )
+
         init_guard = decl["init_guard"]
         flush_guard = decl["flush_guard"]
         group = decl.get("group_inner")
+        revisit = decl.get("revisit")
+
+        if init_guard is None and flush_guard is None:
+            # stateless map kernel (e.g. the delta kernel): no cross-step
+            # accumulator, so the only K2 obligation is that every
+            # declared output is actually written
+            for name in decl["outputs"]:
+                if not _subscript_stores(fn, (name,))[name]:
+                    report.add(
+                        "K2", ERROR, site,
+                        f"output '{name}' is never stored",
+                    )
+            continue
 
         # guard vars must be derived from the plan's IS_FIRST / IS_LAST
+        # (and, for a revisit-accumulated output, QVF / QVL)
         bindings = {}
         for node in ast.walk(fn):
             if (
@@ -950,7 +1037,13 @@ def check_kernel_sources(
                 and isinstance(node.targets[0], ast.Name)
             ):
                 bindings[node.targets[0].id] = ast.unparse(node.value)
-        for var, col in ((init_guard, "IS_FIRST"), (flush_guard, "IS_LAST")):
+        guard_cols = [(init_guard, "IS_FIRST"), (flush_guard, "IS_LAST")]
+        if revisit:
+            guard_cols += [
+                (revisit["init_guard"], "QVF"),
+                (revisit["flush_guard"], "QVL"),
+            ]
+        for var, col in guard_cols:
             if col not in bindings.get(var, ""):
                 report.add(
                     "K2", ERROR, site,
@@ -1012,7 +1105,11 @@ def check_kernel_sources(
                 )
 
         # outputs: stored exactly once, only under the flush guard
-        outputs = tuple(decl["outputs"])
+        # (a revisit-accumulated output follows its own discipline below)
+        outputs = tuple(
+            n for n in decl["outputs"]
+            if not revisit or n != revisit["out"]
+        )
         flush_assigns: dict[str, int] = {n: 0 for n in outputs}
         flush_nodes: set[int] = set()
         for _conds, node in flush_blocks:
@@ -1044,20 +1141,70 @@ def check_kernel_sources(
                     f"times — the contract requires exactly one flush",
                 )
 
-        # K4 source half: every MXU contraction accumulates in f32
-        for node in ast.walk(fn):
-            if (
-                isinstance(node, ast.Call)
-                and _callee_name(node.func) == "dot_general"
-            ):
-                kw = {k.arg: k.value for k in node.keywords}
-                pet = kw.get("preferred_element_type")
-                if pet is None or not ast.unparse(pet).endswith("float32"):
+        # revisit-accumulated output: the k-major traversal revisits the
+        # same output block across work items, so the kernel must (a)
+        # zero it on the q tile's FIRST visit — on hardware the window's
+        # initial VMEM content is undefined; interpret mode hides this —
+        # (b) flush exactly once on the LAST visit, and (c) only ever
+        # accumulate (+=) in between, never overwrite
+        if revisit:
+            rout = revisit["out"]
+            rvf = revisit["init_guard"]
+            rvl = revisit["flush_guard"]
+            r_init_ids: set[int] = set()
+            has_init = False
+            for conds, node in blocks:
+                if (rvf, "1") not in conds:
+                    continue
+                for a in _subscript_stores(node, (rout,))[rout]:
+                    r_init_ids.add(id(a))
+                    val = getattr(a, "value", None)
+                    if (
+                        isinstance(a, ast.Assign)
+                        and isinstance(val, ast.Call)
+                        and _callee_name(val.func) in init_fns
+                    ):
+                        has_init = True
+            if not has_init:
+                report.add(
+                    "K2", ERROR, site,
+                    f"revisit-accumulated output '{rout}' is never zero-"
+                    f"initialized under the {rvf} (first-visit) guard — "
+                    f"on hardware the output window's first-visit VMEM "
+                    f"content is undefined, so accumulation starts from "
+                    f"garbage",
+                )
+            r_flush_ids: set[int] = set()
+            n_flush = 0
+            for conds, node in blocks:
+                if (rvl, "1") not in conds:
+                    continue
+                assigns = _subscript_stores(node, (rout,))[rout]
+                n_flush += len(assigns)
+                r_flush_ids.update(id(a) for a in assigns)
+            if n_flush == 0:
+                report.add(
+                    "K2", ERROR, site,
+                    f"revisit-accumulated output '{rout}' is never "
+                    f"flushed under the {rvl} (last-visit) guard",
+                )
+            elif n_flush > 1:
+                report.add(
+                    "K2", ERROR, site,
+                    f"revisit-accumulated output '{rout}' is flushed "
+                    f"{n_flush} times — the contract requires exactly "
+                    f"one last-visit flush",
+                )
+            for a in _subscript_stores(fn, (rout,))[rout]:
+                if id(a) in r_init_ids or id(a) in r_flush_ids:
+                    continue
+                if not isinstance(a, ast.AugAssign):
                     report.add(
-                        "K4", ERROR, f"{site}:{node.lineno}",
-                        "dot_general without "
-                        "preferred_element_type=jnp.float32 — MXU "
-                        "accumulation falls back to the input dtype",
+                        "K2", ERROR, site,
+                        f"revisit-accumulated output '{rout}' is plainly "
+                        f"assigned outside the {rvf}/{rvl} guards (line "
+                        f"{a.lineno}) — a revisit would overwrite, not "
+                        f"accumulate, earlier work items' contributions",
                     )
 
 
@@ -1412,6 +1559,55 @@ _TOY_CONTRACTS = {
     ),
 }
 
+# minimal fused-style kernel: a scratch accumulator (is_first/is_last)
+# PLUS a revisit-accumulated output (qvf/qvl) — the shape the
+# deleted_revisit_init mutation operates on
+_TOY_FUSED_KERNEL_SRC = '''
+def _toy_fused_kernel(qt_ref, kt_ref, meta_ref, x_ref, dq_ref, o_ref,
+                      acc_scr):
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    qvf = meta_ref[w, QVF]
+    qvl = meta_ref[w, QVL]
+
+    @pl.when(is_first == 1)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(qvf == 1)
+    def _():
+        dq_ref[0] = jnp.zeros((8, 8), jnp.float32)
+
+    contrib = jax.lax.dot_general(
+        x_ref[:], x_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[:] += contrib
+    dq_ref[0] += contrib
+
+    @pl.when(is_last == 1)
+    def _():
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
+
+    @pl.when(qvl == 1)
+    def _():
+        dq_ref[0] = dq_ref[0] * 2.0
+'''
+
+_TOY_FUSED_CONTRACTS = {
+    "_toy_fused_kernel": dict(
+        wrapper="_toy_fused",
+        scratch=("acc_scr",),
+        outputs=("dq_ref", "o_ref"),
+        out_dtypes=("f32", "input"),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=None,
+        revisit=dict(out="dq_ref", init_guard="qvf", flush_guard="qvl"),
+    ),
+}
+
 
 def _mutation_spec() -> AuditSpec:
     # hq (8) > num_q_tiles (4) so the swapped-axes mutation is provably
@@ -1507,9 +1703,23 @@ def run_seeded_mutations() -> list[dict]:
         )
         check_contract(report, mut, "mutation:corrupted_extent_row")
 
+    def no_revisit_init(report: VerifyReport) -> None:
+        # delete the qvf first-visit zeroing of the revisit-accumulated
+        # output — interpret mode still passes (the donated output buffer
+        # happens to start zeroed) but hardware VMEM is undefined on the
+        # first visit, so only K2's revisit rule can catch it
+        src = _TOY_FUSED_KERNEL_SRC
+        start = src.index("    @pl.when(qvf == 1)")
+        end = src.index("    contrib = ")
+        check_kernel_sources(
+            report, src[:start] + src[end:], _TOY_FUSED_CONTRACTS,
+            "mutation.py",
+        )
+
     run("oversized_scratch", "K1", oversized)
     run("swapped_index_map_axes", "K3", swapped)
     run("missing_accumulator_init", "K2", no_init)
+    run("deleted_revisit_init", "K2", no_revisit_init)
     run("bf16_accumulator", "K4", bf16_scratch)
     run("unlisted_env_key", "K5", unlisted_key)
     run("corrupted_extent_row", "K3", bad_extent)

@@ -593,9 +593,11 @@ def check_tiles(
 
 def check_plan_extents(report: VerifyReport, plan) -> None:
     """R5, extent half: an FFA plan's live-extent meta columns (EQ0..EK1)
-    must equal the host recomputation from its own 9-col band geometry,
-    for BOTH triples (q-major and k-major), and the executed-element count
-    they imply must not exceed the padded tile work. The kernels skip
+    AND its q-visit flag columns (QVF/QVL — the fused backward's dq
+    revisit init/flush guards) must equal the host recomputation from its
+    own 9-col band geometry, for BOTH triples (q-major and k-major), and
+    the executed-element count they imply must not exceed the padded tile
+    work. The kernels skip
     dot_general chunks on these columns (kernels/ffa.py clamp path), so a
     stale or truncated row silently drops attention mass — the same
     invariant rule K3's extent half proves on captured contracts, applied
@@ -606,6 +608,7 @@ def check_plan_extents(report: VerifyReport, plan) -> None:
         EQ0,
         META_DIM,
         _extend_meta_extents,
+        _extend_meta_visits,
         plan_extent_stats,
     )
 
@@ -620,12 +623,15 @@ def check_plan_extents(report: VerifyReport, plan) -> None:
             report.add(
                 "R5", ERROR, which,
                 f"plan meta has {meta.shape} columns, expected {META_DIM} "
-                "(9 band cols + 4 live-extent cols)",
+                "(9 band cols + 4 live-extent cols + 2 q-visit cols)",
             )
             continue
-        want = _extend_meta_extents(
-            meta[:, :EQ0].astype(np.int32), np.asarray(wq), np.asarray(wk),
-            plan.block_q, plan.block_k,
+        want = _extend_meta_visits(
+            _extend_meta_extents(
+                meta[:, :EQ0].astype(np.int32), np.asarray(wq),
+                np.asarray(wk), plan.block_q, plan.block_k,
+            ),
+            np.asarray(wq),
         )
         bad = np.nonzero((meta != want).any(axis=1))[0]
         for w in bad[:8]:

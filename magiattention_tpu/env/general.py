@@ -168,6 +168,8 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     # changes which plans/kernels a mask dispatches to
     "MAGI_ATTENTION_FFA_EXTENT_CLAMP",
     "MAGI_ATTENTION_FFA_MIXED_BLOCKS",
+    # fused vs split backward changes which kernels the vjp traces
+    "MAGI_ATTENTION_FFA_FUSED_BWD",
     # wire-tier selection changes the traced collective program
     "MAGI_ATTENTION_RAGGED_GRPCOLL",
     "MAGI_ATTENTION_SPLIT_ALIGNMENT",

@@ -81,6 +81,18 @@ def ffa_mixed_blocks() -> str:
     return _get_str("MAGI_ATTENTION_FFA_MIXED_BLOCKS", "auto").lower()
 
 
+def ffa_fused_bwd() -> str:
+    """Fused one-pass FFA backward: 'auto' (the tile_policy cost model
+    picks fused vs split per band shape / dtype / group, under the fused
+    VMEM residency guard), '1' (fused whenever feasible — the VMEM guard
+    and the plan's q-visit meta columns still gate it), '0' (always the
+    split dq + dkv passes). The fused kernel recomputes scores ONCE per
+    work item for dq, dk AND dv — 5 tile matmuls where split spends 7 —
+    accumulating dq by revisiting its output block across the k-major
+    traversal (see docs/backward_fusion.md)."""
+    return _get_str("MAGI_ATTENTION_FFA_FUSED_BWD", "auto").lower()
+
+
 def ffa_gqa_pack_dq() -> bool:
     """GQA-pack the dq backward kernel (grid (hk, W)): k/v fetched once
     per work item instead of per q-head, s/dp matmuls g x taller,

@@ -41,11 +41,12 @@ from ..env import resilience as env_resilience
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
-    ffa_bwd_dkv_pallas_dispatch,
-    ffa_bwd_dq_pallas_dispatch,
+    ffa_bwd_pallas_dispatch,
+    ffa_delta_pallas_dispatch,
     ffa_fwd_pallas_dispatch,
     _should_interpret,
     ffa_attn_with_plan,
+    resolved_bwd_mode,
 )
 from ..kernels.ffa_plan import build_ffa_plan, pad_plan
 from ..meta.collection.calc_meta import AttnArg, CalcMeta
@@ -114,9 +115,14 @@ def _multi_ffa_bwd(params_list, res, cts):
     do, _, _ = cts  # lse/max_logits cotangents ignored (auxiliary outputs)
     q, ks, vs, out, lse, arrays_list = res
     sq = q.shape[0]
-    delta = jnp.sum(
-        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (sq, hq)
+    # delta = rowsum(do ⊙ out) on the MXU-free VPU path (Pallas kernel),
+    # computed once at part 0's tile geometry and shared by every part
+    prm0 = params_list[0]
+    sqp0 = prm0.num_q_tiles * prm0.block_q
+    with profile_scope("ffa_bwd_delta"):
+        delta = ffa_delta_pallas_dispatch(
+            prm0, _head_major(out, sqp0), _head_major(do, sqp0)
+        ).T[:sq]  # (sq, hq)
 
     dq_total = None
     dks, dvs = [], []
@@ -133,13 +139,9 @@ def _multi_ffa_bwd(params_list, res, cts):
         ).T
         delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
         dq_arrs, dkv_arrs = _bwd_plan_slices(arrs)
-        with profile_scope("ffa_bwd_dq"):
-            dq_t = ffa_bwd_dq_pallas_dispatch(
-                prm, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
-            )
-        with profile_scope("ffa_bwd_dkv"):
-            dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
-                prm, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
+        with profile_scope("ffa_bwd"):
+            dq_t, dk_t, dv_t = ffa_bwd_pallas_dispatch(
+                prm, dq_arrs, dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
             )
         # dk/dv already per kv head (dkv kernel sums the GQA group); the
         # kernels emit fp32, so the casts are identity under HP reduce
@@ -617,6 +619,11 @@ class DistAttnRuntime(DeferredTilePolicy):
                 self._plan_group_stats()  # telemetry enabled after build
             band = self._tel_band_elems
             padded = sum(g["padded_elems"] for g in self._tel_plan_groups)
+            # backward execution mode the dispatch will pick for this
+            # geometry (fused one-pass vs split dq+dkv) — resolved on the
+            # representative (host/merged) plan dims
+            dims0 = self._host_dims if self.use_overlap else self._merged_dims
+            prm0 = self._ffa_params(dims0, 1.0, hq // hk)
             payload.update(
                 block_q=self._bq, block_k=self._bk,
                 plan_groups=self._tel_plan_groups,
@@ -625,6 +632,10 @@ class DistAttnRuntime(DeferredTilePolicy):
                 # fwd FLOPs, FlashAttention-2 convention (perf_report.py)
                 est_flops_fwd=4 * band * dh * hq,
                 padded_flops_fwd=4 * padded * dh * hq,
+                bwd_mode=resolved_bwd_mode(
+                    prm0, prm0.num_q_tiles * prm0.block_q, dh, dv,
+                    q.dtype.itemsize,
+                ),
             )
         return payload
 

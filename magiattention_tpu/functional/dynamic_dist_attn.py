@@ -37,11 +37,12 @@ from ..env import resilience as env_resilience
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
-    ffa_bwd_dkv_pallas_dispatch,
-    ffa_bwd_dq_pallas_dispatch,
+    ffa_bwd_pallas_dispatch,
+    ffa_delta_pallas_dispatch,
     _should_interpret,
     default_blocks,
     ffa_attn_with_plan,
+    resolved_bwd_mode,
 )
 from ..meta.collection.dynamic_meta import DynamicAttnPlan
 from ..utils.profiling import instrument_scope, profile_scope
@@ -123,10 +124,13 @@ def _dyn_bwd(static, axis, res, cts):
     k_buf = jnp.concatenate([k, k_rem], axis=0)
     v_buf = jnp.concatenate([v, v_rem], axis=0)
 
-    # owner-side final quantities, re-distributed over the q cast
-    delta = jnp.sum(
-        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (shard, hq)
+    # owner-side final quantities, re-distributed over the q cast; delta
+    # runs on the local shard rows (pre-cast), so pad to a block_q multiple
+    bq = params.block_q
+    sp = -(-out.shape[0] // bq) * bq
+    delta = ffa_delta_pallas_dispatch(
+        params, _head_major(out, sp), _head_major(do, sp)
+    ).T[: out.shape[0]]  # (shard, hq)
     do_buf = jnp.concatenate(
         [do, cast_rows(do, q_ops, q_kind, axis)], axis=0
     )
@@ -150,11 +154,8 @@ def _dyn_bwd(static, axis, res, cts):
     delta_t = jnp.pad(delta_buf, ((0, sqp - nbuf), (0, 0))).T
 
     dq_arrs, dkv_arrs = _bwd_plan_slices(arrays)
-    dq_t = ffa_bwd_dq_pallas_dispatch(
-        params, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
-    )
-    dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
-        params, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
+    dq_t, dk_t, dv_t = ffa_bwd_pallas_dispatch(
+        params, dq_arrs, dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     # dk/dv already per kv head (dkv kernel sums the GQA group)
 
@@ -301,12 +302,24 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
                 telemetry.band_area(a.q_ranges, a.k_ranges, a.d_lo, a.d_hi)
                 for a in p.attn_args
             )
+            # backward execution mode the combined dispatch will pick
+            # (fused one-pass vs split dq+dkv) for this plan's geometry
+            nqt, nkt, wn, wt, overrides = self._dims
+            prm0 = FFAParams(
+                num_work=wn, num_work_t=wt, num_q_tiles=nqt,
+                num_k_tiles=nkt, block_q=self._bq, block_k=self._bk,
+                **overrides, softmax_scale=1.0, softcap=self.softcap,
+                group=hq // hk, interpret=_should_interpret(),
+            )
             payload.update(
                 block_q=self._bq, block_k=self._bk,
                 band_elems=band,
                 padded_elems=padded,
                 est_flops_fwd=4 * band * dh * hq,
                 padded_flops_fwd=4 * padded * dh * hq,
+                bwd_mode=resolved_bwd_mode(
+                    prm0, nqt * self._bq, dh, dv, q.dtype.itemsize
+                ),
             )
         return payload
 

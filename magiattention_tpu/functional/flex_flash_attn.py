@@ -227,8 +227,8 @@ def _ffa_sink_core_fwd(q, k, v, sink, arrays, params, sink_layout):
 def _ffa_sink_core_bwd(params, sink_layout, res, cts):
     from ..kernels.ffa import (
         _bwd_plan_slices,
-        ffa_bwd_dkv_pallas_dispatch,
-        ffa_bwd_dq_pallas_dispatch,
+        ffa_bwd_pallas_dispatch,
+        ffa_delta_pallas_dispatch,
     )
     from .dist_attn import _head_major
     from .sink import sink_bwd
@@ -236,23 +236,23 @@ def _ffa_sink_core_bwd(params, sink_layout, res, cts):
     do, _ = cts
     q, k, v, sink, out, lse, arrays = res
     sq = q.shape[0]
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     sqp = params.num_q_tiles * params.block_q
     skp = params.num_k_tiles * params.block_k
     q_t, k_t, v_t = (
         _head_major(q, sqp), _head_major(k, skp), _head_major(v, skp)
     )
     do_t = _head_major(do, sqp)
+    # delta via the Pallas rowsum kernel; padded rows are exactly zero
+    # (zero-padded inputs), so (hq, sqp) doubles as delta_t and its
+    # [:sq] rows feed sink_bwd
+    delta_t = ffa_delta_pallas_dispatch(params, _head_major(out, sqp), do_t)
+    delta = delta_t.T[:sq]
     lse_t = jnp.pad(
         lse, ((0, sqp - sq), (0, 0)), constant_values=float("-inf")
     ).T
-    delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
     dq_arrs, dkv_arrs = _bwd_plan_slices(arrays)
-    dq_t = ffa_bwd_dq_pallas_dispatch(
-        params, *dq_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
-    )
-    dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
-        params, *dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
+    dq_t, dk_t, dv_t = ffa_bwd_pallas_dispatch(
+        params, dq_arrs, dkv_arrs, q_t, k_t, v_t, do_t, lse_t, delta_t
     )
     # dk/dv already per kv head (dkv kernel sums the GQA group)
     dsink = sink_bwd(sink, lse, delta, sink_layout)

@@ -70,6 +70,8 @@ from .ffa_plan import (  # noqa: F401
     KS,
     QE,
     QS,
+    QVF,
+    QVL,
     FFAPlan,
     get_ffa_plan,
 )
@@ -748,6 +750,7 @@ def _bwd_dq_kernel(
     dq_scr,
     *,
     softcap: float,
+    scale: float,
     bq: int,
     bk: int,
     nc: int,
@@ -861,7 +864,9 @@ def _bwd_dq_kernel(
 
     @pl.when(is_last == 1)
     def _():
-        dq_ref[0] = dq_scr[:]
+        # softmax_scale folds into the flush (ds carries no scale): one VPU
+        # multiply on the resident tile instead of an XLA full-array pass
+        dq_ref[0] = dq_scr[:] * scale
 
 
 def _clamp_lse(lse_t: jax.Array) -> jax.Array:
@@ -909,7 +914,7 @@ def _ffa_bwd_dq_pallas(
     )
     kernel = partial(
         _bwd_dq_kernel, softcap=params.softcap,
-        bq=bq, bk=bk, nc=_clamp_chunks(bk),
+        scale=params.softmax_scale, bq=bq, bk=bk, nc=_clamp_chunks(bk),
     )
     (dq_t,) = pl.pallas_call(
         kernel,
@@ -921,7 +926,7 @@ def _ffa_bwd_dq_pallas(
         ),
     )(work_qt, work_kt, meta, q_t, k_t, v_t, do_t,
       _lanes_layout(_clamp_lse(lse_t), 1), _lanes_layout(delta_t, 1))
-    return dq_t * params.softmax_scale
+    return dq_t  # softmax_scale already folded into the kernel flush
 
 
 def _bwd_dq_kernel_gqa(
@@ -938,6 +943,7 @@ def _bwd_dq_kernel_gqa(
     dq_scr,
     *,
     softcap: float,
+    scale: float,
     bq: int,
     bk: int,
     g: int,
@@ -1061,7 +1067,8 @@ def _bwd_dq_kernel_gqa(
 
     @pl.when(is_last == 1)
     def _():
-        dq_ref[0] = dq_scr[:].reshape(g, bq, d)
+        # softmax_scale folded into the flush (see _bwd_dq_kernel)
+        dq_ref[0] = (dq_scr[:] * scale).reshape(g, bq, d)
 
 
 def _tile_pack_rows(x_t: jax.Array, hk: int, g: int, bq: int) -> jax.Array:
@@ -1125,7 +1132,8 @@ def _ffa_bwd_dq_pallas_gqa(
         scratch_shapes=[pltpu.VMEM((g * bq, d), jnp.float32)],
     )
     kernel = partial(
-        _bwd_dq_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g,
+        _bwd_dq_kernel_gqa, softcap=params.softcap,
+        scale=params.softmax_scale, bq=bq, bk=bk, g=g,
         nc=_clamp_chunks(bk),
     )
     (dq_g,) = pl.pallas_call(
@@ -1137,7 +1145,7 @@ def _ffa_bwd_dq_pallas_gqa(
             dimension_semantics=("parallel", "arbitrary"),
         ),
     )(work_qt, work_kt, meta, q_g, k_t, v_t, do_g, lse_p, delta_p)
-    return dq_g.reshape(hq, sqp, d) * params.softmax_scale
+    return dq_g.reshape(hq, sqp, d)  # scale folded into the kernel flush
 
 
 def _use_gqa_pack_dq(
@@ -1691,6 +1699,776 @@ def ffa_bwd_dkv_pallas_dispatch(
 
 
 # ---------------------------------------------------------------------------
+# backward: delta preprocessing (rowsum of dO ⊙ O)
+# ---------------------------------------------------------------------------
+
+
+def _delta_kernel(o_ref, do_ref, delta_ref, *, bq: int):
+    """delta = rowsum(dO ⊙ O) in fp32 for one (head, q-tile) block.
+
+    Shared preprocessing of every backward pass (split dq, split dkv, and
+    the fused one-pass kernel all consume delta); running it as a Pallas
+    kernel removes the XLA full-array pass over o and do the old
+    ``jnp.sum`` epilogue cost. The result is emitted lanes-broadcast
+    ``(bq, NUM_LANES)`` — the proven lse output layout — and sliced to a
+    column on the host; no accumulator, every grid step is independent.
+    """
+    prod = o_ref[0].astype(jnp.float32) * do_ref[0].astype(jnp.float32)
+    col = jnp.sum(prod, axis=-1)[:, None]  # (bq, 1)
+    delta_ref[0] = jnp.broadcast_to(col, (bq, NUM_LANES))
+
+
+def _ffa_delta_pallas(out_t, do_t, block_q: int, interpret: bool):
+    """Tiled delta kernel over head-major padded (hq, sqp, dv) arrays.
+
+    ``block_q`` must divide sqp (always true for the fwd padded geometry:
+    sqp = num_q_tiles * block_q). Returns (hq, sqp) fp32.
+    """
+    hq, sqp, dv = out_t.shape
+    bq = min(block_q, sqp)
+    nqt = sqp // bq
+    (delta_b,) = pl.pallas_call(
+        partial(_delta_kernel, bq=bq),
+        grid=(hq, nqt),
+        in_specs=[
+            pl.BlockSpec((1, bq, dv), lambda h, i: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, dv), lambda h, i: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, NUM_LANES), lambda h, i: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((hq, sqp, NUM_LANES), jnp.float32)],
+        interpret=interpret,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+    )(out_t, do_t)
+    return delta_b[..., 0]
+
+
+def ffa_delta_pallas_dispatch(params: FFAParams, out_t, do_t):
+    """delta preprocessing entry used by every backward path (mirrors the
+    fwd/dq/dkv dispatch naming so the static kernel checker drives it the
+    same way)."""
+    return _ffa_delta_pallas(out_t, do_t, params.block_q, params.interpret)
+
+
+# ---------------------------------------------------------------------------
+# backward: fused one-pass (k-major plan, revisit-accumulated dq)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_fused_kernel(
+    work_qt_ref,
+    work_kt_ref,
+    meta_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dqz_ref,
+    dq_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    softcap: float,
+    scale: float,
+    bq: int,
+    bk: int,
+    group: int,
+    nc: int,
+):
+    """Fused one-pass backward: dk, dv AND dq from ONE score recompute.
+
+    Same grid and dk/dv discipline as :func:`_bwd_dkv_kernel` (k-major
+    plan, grid (hk, WT, g), group innermost, VMEM scratch flushed on the
+    k tile's last visit). The fused extra: each work item's dq
+    contribution ``ds @ k`` is accumulated directly into the REVISITED dq
+    output window — the k-major traversal visits one q tile many times,
+    non-consecutively, so there is no scratch run to accumulate in;
+    instead the output block itself is read-modify-written across visits:
+    zero-initialized when the plan's first-q-visit flag (QVF) is set,
+    accumulated every visit, and flushed (folding softmax_scale) on the
+    last-q-visit flag (QVL). Never-visited q tiles (fully masked rows)
+    keep the aliased zero background the wrapper passes as ``dqz_ref``.
+    This shares the s_t/p_t recompute between dq and dk/dv — 5 tile
+    matmuls per work item where the split passes spend 7 — and halves the
+    backward HBM reads of q/k/v/do.
+    """
+    w = pl.program_id(1)
+    gi = pl.program_id(2)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    is_full = meta_ref[w, IS_FULL]
+    qvf = meta_ref[w, QVF]
+    qvl = meta_ref[w, QVL]
+    use_exp2 = softcap == 0.0
+    exp_fn = jnp.exp2 if use_exp2 else jnp.exp
+    del dqz_ref  # aliased zero background only; never read in-kernel
+
+    @pl.when((is_first == 1) & (gi == 0))
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    d = q_ref.shape[-1]
+
+    # revisit-accumulation init: this (head, q tile) dq window is seen for
+    # the first time in the k-major traversal — start it from zero
+    @pl.when(qvf == 1)
+    def _():
+        dq_ref[0] = jnp.zeros((bq, d), jnp.float32)
+
+    q = q_ref[0]  # pre-scaled by softmax_scale (* log2e when softcap-free)
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+
+    def score(q_blk):
+        s_t = jax.lax.dot_general(
+            k, q_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if softcap > 0.0:
+            sc_t = softcap * jnp.tanh(s_t / softcap)
+            return sc_t, 1.0 - (sc_t / softcap) ** 2
+        return s_t, None
+
+    def accum(sm_t, dcap_t, lse_c, delta_c, do_blk, q_blk, c0: int,
+              rows: int, masked: bool):
+        dp_t = jax.lax.dot_general(
+            v, do_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if masked:
+            neg = lse_c <= EMPTY_THRESH
+            lse_safe = jnp.where(neg, 0.0, lse_c)
+            if use_exp2:
+                lse_safe = lse_safe * LOG2E
+            p_t = exp_fn(sm_t - lse_safe)
+            p_t = jnp.where(neg, 0.0, p_t)
+        else:
+            p_t = exp_fn(sm_t - (lse_c * LOG2E if use_exp2 else lse_c))
+        dv_scr[:] += jax.lax.dot_general(
+            p_t.astype(do.dtype), do_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - delta_c)
+        if dcap_t is not None:
+            ds_t = ds_t * dcap_t
+        # q is pre-scaled, so ds_t @ q' == (ds_t * scale) @ q == dk exactly
+        dk_scr[:] += jax.lax.dot_general(
+            ds_t.astype(q.dtype), q_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # the fused extra product: ds^T-contraction with k gives this
+        # item's (rows, d) dq contribution, read-modify-written into the
+        # revisited output window (k carries NO scale; applied at flush)
+        dq_ref[0, c0:c0 + rows] += jax.lax.dot_general(
+            ds_t.astype(k.dtype), k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # lse/delta q-in-lanes rows: ref block (sublanes, bq) -> (1, bq) views
+    lse = lse_ref[:1, :]
+    delta = delta_ref[:1, :]
+
+    if nc == 0:
+        sc_t, dcap_t = score(q)
+
+        @pl.when(is_full == 1)
+        def _():
+            accum(sc_t, dcap_t, lse, delta, do, q, 0, bq, masked=False)
+
+        @pl.when(is_full == 0)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            accum(
+                jnp.where(
+                    _item_mask(meta_ref, w, q_base, k_base, bq, bk,
+                               transposed=True),
+                    sc_t, MASK_VALUE,
+                ),
+                dcap_t, lse, delta, do, q, 0, bq,
+                masked=True,
+            )
+    else:
+        # extent-clamped body (see _bwd_dkv_kernel): q is the lane dim of
+        # s_t, so partial tiles chunk the q extent; a skipped chunk's p_t
+        # was exactly 0 in the unclamped path, so its dq/dk/dv terms all
+        # vanish and dropping it changes nothing
+        cq = bq // nc
+        eq0, eq1, _, _, live = _item_extents(meta_ref, w)
+
+        @pl.when(is_full == 1)
+        def _():
+            sc_t, dcap_t = score(q)
+            accum(sc_t, dcap_t, lse, delta, do, q, 0, bq, masked=False)
+
+        for c in range(nc):
+            c0 = c * cq
+
+            @pl.when((is_full == 0) & live & (eq0 < c0 + cq) & (eq1 > c0))
+            def _(c0=c0):
+                q_base = work_qt_ref[w] * bq
+                k_base = work_kt_ref[w] * bk
+                q_c = q[c0 : c0 + cq]
+                sc_t, dcap_t = score(q_c)
+                accum(
+                    jnp.where(
+                        _item_mask(meta_ref, w, q_base + c0, k_base, cq,
+                                   bk, transposed=True),
+                        sc_t, MASK_VALUE,
+                    ),
+                    dcap_t,
+                    lse_ref[:1, c0 : c0 + cq],
+                    delta_ref[:1, c0 : c0 + cq],
+                    do[c0 : c0 + cq],
+                    q_c,
+                    c0, cq,
+                    masked=True,
+                )
+
+    @pl.when((is_last == 1) & (gi == group - 1))
+    def _():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+    # revisit-accumulation flush: last visit of this q tile — fold
+    # softmax_scale into the resident window (both exp2 and softcap paths
+    # accumulate the UNSCALED ds @ k above)
+    @pl.when(qvl == 1)
+    def _():
+        dq_ref[0] = dq_ref[0] * scale
+
+
+def _ffa_bwd_fused_pallas(
+    params: FFAParams, work_qt_t, work_kt_t, meta_t,
+    q_t, k_t, v_t, do_t, lse_t, delta_t,
+):
+    """Fused one-pass backward pallas call (see :func:`_bwd_fused_kernel`).
+
+    Returns (dq_t, dk_t, dv_t), all fp32. The dq output is aliased to a
+    zero input (``input_output_aliases``) whose CONSTANT index map fetches
+    one window exactly once: q tiles the k-major work list never visits
+    (fully masked rows) keep that zero background, so no dummy work items
+    are needed and the plan's work counts are untouched.
+    """
+    bq, bk = params.dkv_blocks()
+    hq, sqp, d = q_t.shape
+    hk, skp, dv = v_t.shape
+    g = params.group
+    WT = (
+        params.num_work_dkv
+        if params.num_work_dkv is not None
+        else params.num_work_t
+    )
+
+    use_exp2 = params.softcap == 0.0
+    q_scale = params.softmax_scale * (LOG2E if use_exp2 else 1.0)
+    q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
+    dqz = jnp.zeros((hq, sqp, d), jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(hk, WT, g),
+        in_specs=[
+            pl.BlockSpec(
+                (1, bq, d),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, w, gi, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, dv), lambda h, w, gi, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bq, dv),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, NUM_SUBLANES, bq),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, 0, qt[w]),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, NUM_SUBLANES, bq),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, 0, qt[w]),
+                memory_space=pltpu.VMEM,
+            ),
+            # aliased zero background for dq: constant index map — the
+            # window is fetched once, never streamed per step, never read
+            pl.BlockSpec(
+                (1, bq, d), lambda h, w, gi, qt, kt, mt: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, bq, d),
+                lambda h, w, gi, qt, kt, mt: (h * g + gi, qt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, d), lambda h, w, gi, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, bk, dv), lambda h, w, gi, qt, kt, mt: (h, kt[w], 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, dv), jnp.float32),
+        ],
+    )
+    kernel = partial(
+        _bwd_fused_kernel, softcap=params.softcap,
+        scale=params.softmax_scale, bq=bq, bk=bk, group=g,
+        nc=_clamp_chunks(bq),
+    )
+    dq_t, dk_t, dv_t = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hq, sqp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hk, skp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hk, skp, dv), jnp.float32),
+        ],
+        # operand 9 (dqz, counting the 3 scalar-prefetch args) -> output 0
+        input_output_aliases={9: 0},
+        interpret=params.interpret,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+    )(work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
+      _lanes_layout(_clamp_lse(lse_t), NUM_SUBLANES),
+      _lanes_layout(delta_t, NUM_SUBLANES), dqz)
+    if use_exp2:
+        dk_t = dk_t * LN2  # divide the folded log2e back out
+    return dq_t, dk_t, dv_t
+
+
+def _bwd_fused_kernel_gqa(
+    work_qt_ref,
+    work_kt_ref,
+    meta_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dqz_ref,
+    dq_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    softcap: float,
+    scale: float,
+    bq: int,
+    bk: int,
+    g: int,
+    clamp: bool,
+):
+    """GQA-packed fused one-pass backward: grid (hk, WT), the whole query
+    group of one kv head per step (see :func:`_bwd_dkv_kernel_gqa` for the
+    packing scheme). The dq window is the full (g, bq, d) group block of
+    the work item's q tile, revisit-accumulated under the same QVF/QVL
+    discipline as :func:`_bwd_fused_kernel` — one init and one flush per
+    tile visit run covers all g heads at once. Clamping is the whole-item
+    live guard (the packed lane dim interleaves the g heads' q rows, so
+    it cannot be chunked by a single q extent); init/flush stay OUTSIDE
+    the guard so dead items still honor their visit flags.
+    """
+    w = pl.program_id(1)
+    is_first = meta_ref[w, IS_FIRST]
+    is_last = meta_ref[w, IS_LAST]
+    is_full = meta_ref[w, IS_FULL]
+    qvf = meta_ref[w, QVF]
+    qvl = meta_ref[w, QVL]
+    use_exp2 = softcap == 0.0
+    exp_fn = jnp.exp2 if use_exp2 else jnp.exp
+    del dqz_ref  # aliased zero background only; never read in-kernel
+
+    @pl.when(is_first == 1)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    d = q_ref.shape[-1]
+    dv = v_ref.shape[-1]
+
+    @pl.when(qvf == 1)
+    def _():
+        dq_ref[0] = jnp.zeros((g, bq, d), jnp.float32)
+
+    q = q_ref[0].reshape(g * bq, d)  # pre-scaled on host
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].reshape(g * bq, dv)
+
+    lse = lse_ref[...]  # (1, g*bq), tile-packed cols
+    delta = delta_ref[...]
+
+    def score():
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if softcap > 0.0:
+            sc_t = softcap * jnp.tanh(s_t / softcap)
+            return sc_t, 1.0 - (sc_t / softcap) ** 2
+        return s_t, None
+
+    def accum(sm_t, dcap_t, masked: bool):
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if masked:
+            neg = lse <= EMPTY_THRESH
+            lse_safe = jnp.where(neg, 0.0, lse)
+            if use_exp2:
+                lse_safe = lse_safe * LOG2E
+            p_t = exp_fn(sm_t - lse_safe)
+            p_t = jnp.where(neg, 0.0, p_t)
+        else:
+            p_t = exp_fn(sm_t - (lse * LOG2E if use_exp2 else lse))
+        dv_scr[:] += jax.lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - delta)
+        if dcap_t is not None:
+            ds_t = ds_t * dcap_t
+        dk_scr[:] += jax.lax.dot_general(
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # fused dq contribution for ALL g heads at once: (g*bq, d) packed
+        # rows unpacked back into the (g, bq, d) revisited window
+        dq_ref[0] += jax.lax.dot_general(
+            ds_t.astype(k.dtype), k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(g, bq, d)
+
+    if not clamp:
+        sc_t, dcap_t = score()
+
+        @pl.when(is_full == 1)
+        def _():
+            accum(sc_t, dcap_t, masked=False)
+
+        @pl.when(is_full == 0)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            accum(
+                jnp.where(
+                    _item_mask(meta_ref, w, q_base, k_base, bq, bk,
+                               transposed=True, repeat=g),
+                    sc_t, MASK_VALUE,
+                ),
+                dcap_t,
+                masked=True,
+            )
+    else:
+        # whole-item live guard (see _bwd_dkv_kernel_gqa); dead items'
+        # contribution was exactly 0, so skipping their MXU passes is free
+        _, _, _, _, live = _item_extents(meta_ref, w)
+
+        @pl.when((is_full == 1) & live)
+        def _():
+            sc_t, dcap_t = score()
+            accum(sc_t, dcap_t, masked=False)
+
+        @pl.when((is_full == 0) & live)
+        def _():
+            q_base = work_qt_ref[w] * bq
+            k_base = work_kt_ref[w] * bk
+            sc_t, dcap_t = score()
+            accum(
+                jnp.where(
+                    _item_mask(meta_ref, w, q_base, k_base, bq, bk,
+                               transposed=True, repeat=g),
+                    sc_t, MASK_VALUE,
+                ),
+                dcap_t,
+                masked=True,
+            )
+
+    @pl.when(is_last == 1)
+    def _():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+    @pl.when(qvl == 1)
+    def _():
+        dq_ref[0] = dq_ref[0] * scale
+
+
+def _ffa_bwd_fused_pallas_gqa(
+    params: FFAParams, work_qt_t, work_kt_t, meta_t,
+    q_t, k_t, v_t, do_t, lse_t, delta_t,
+):
+    """GQA-packed fused one-pass backward pallas call (see
+    :func:`_bwd_fused_kernel_gqa`)."""
+    bq, bk = params.dkv_blocks()
+    hq, sqp, d = q_t.shape
+    hk, skp, dv = v_t.shape
+    g = params.group
+    WT = (
+        params.num_work_dkv
+        if params.num_work_dkv is not None
+        else params.num_work_t
+    )
+
+    use_exp2 = params.softcap == 0.0
+    q_scale = params.softmax_scale * (LOG2E if use_exp2 else 1.0)
+    q_t = (q_t.astype(jnp.float32) * q_scale).astype(q_t.dtype)
+    q_g = q_t.reshape(hk, g, sqp, d)
+    do_g = do_t.reshape(hk, g, sqp, dv)
+    lse_p = _tile_pack_rows(_clamp_lse(lse_t), hk, g, bq)
+    delta_p = _tile_pack_rows(delta_t, hk, g, bq)
+    dqz = jnp.zeros((hk, g, sqp, d), jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(hk, WT),
+        in_specs=[
+            pl.BlockSpec((1, g, bq, d),
+                         lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, g, bq, dv),
+                         lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, None, 1, g * bq),
+                         lambda h, w, qt, kt, mt: (h, qt[w], 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, None, 1, g * bq),
+                         lambda h, w, qt, kt, mt: (h, qt[w], 0, 0),
+                         memory_space=pltpu.VMEM),
+            # aliased zero background for dq (constant index map)
+            pl.BlockSpec((1, g, bq, d),
+                         lambda h, w, qt, kt, mt: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, g, bq, d),
+                         lambda h, w, qt, kt, mt: (h, 0, qt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda h, w, qt, kt, mt: (h, kt[w], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, dv), jnp.float32),
+        ],
+    )
+    kernel = partial(
+        _bwd_fused_kernel_gqa, softcap=params.softcap,
+        scale=params.softmax_scale, bq=bq, bk=bk, g=g,
+        clamp=env_kernel.ffa_extent_clamp(),
+    )
+    dq_g, dk_t, dv_t = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hk, g, sqp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hk, skp, d), jnp.float32),
+            jax.ShapeDtypeStruct((hk, skp, dv), jnp.float32),
+        ],
+        # operand 9 (dqz, counting the 3 scalar-prefetch args) -> output 0
+        input_output_aliases={9: 0},
+        interpret=params.interpret,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(work_qt_t, work_kt_t, meta_t, q_g, k_t, v_t, do_g, lse_p, delta_p,
+      dqz)
+    if use_exp2:
+        dk_t = dk_t * LN2  # divide the folded log2e back out
+    return dq_g.reshape(hq, sqp, d), dk_t, dv_t
+
+
+def _use_gqa_pack_fused(
+    params: FFAParams, sqp: int, d: int, dv: int, itemsize: int = 2
+) -> bool:
+    """Trace-time dispatch to the packed fused kernel: same conditions as
+    the packed dkv kernel (shared env flag — the packing trade-off is
+    identical) with the LARGER fused residency — dkv's plus the revisited
+    dq window and its aliased zero background (utils/mem_budget
+    ``ffa_kernel_residency("fused", ...)``, one source of truth with K1)."""
+    bq, bk = params.dkv_blocks()
+    return (
+        env_kernel.ffa_gqa_pack_dkv()
+        and params.group > 1
+        and sqp % bq == 0
+        and ffa_kernel_residency(
+            "fused", bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize,
+            group=params.group, packed=True,
+        )
+        <= VMEM_ALLOWED_BYTES
+    )
+
+
+def fused_bwd_feasible(
+    params: FFAParams, sqp: int, d: int, dv: int, itemsize: int = 2
+) -> bool:
+    """True when at least one fused-kernel variant's per-step VMEM
+    residency fits the budget — the guard that forces split mode even
+    under MAGI_ATTENTION_FFA_FUSED_BWD=1."""
+    if _use_gqa_pack_fused(params, sqp, d, dv, itemsize):
+        return True
+    bq, bk = params.dkv_blocks()
+    return (
+        ffa_kernel_residency(
+            "fused", bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize,
+            group=params.group, packed=False,
+        )
+        <= VMEM_ALLOWED_BYTES
+    )
+
+
+def ffa_bwd_fused_pallas_dispatch(
+    params: FFAParams, work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
+    lse_t, delta_t,
+):
+    """Fused one-pass backward with the GQA-packing dispatch applied
+    (mirrors :func:`ffa_bwd_dkv_pallas_dispatch`)."""
+    fn = (
+        _ffa_bwd_fused_pallas_gqa
+        if _use_gqa_pack_fused(params, q_t.shape[1], q_t.shape[2],
+                               v_t.shape[2], q_t.dtype.itemsize)
+        else _ffa_bwd_fused_pallas
+    )
+    return fn(params, work_qt_t, work_kt_t, meta_t, q_t, k_t, v_t, do_t,
+              lse_t, delta_t)
+
+
+def ffa_bwd_mode(
+    params: FFAParams, sqp: int, d: int, dv: int, itemsize: int,
+    meta_cols: int,
+) -> str:
+    """Resolved backward execution mode — "fused" or "split" — decidable
+    at trace time (static work counts / blocks / dims only; no plan
+    contents, which may be traced arrays under shard_map).
+
+    MAGI_ATTENTION_FFA_FUSED_BWD: "0" always split; "1" fused whenever
+    feasible (VMEM + plan meta carries the q-visit flag columns); "auto"
+    (default) lets the tile_policy cost model pick per geometry.
+    """
+    flag = env_kernel.ffa_fused_bwd()
+    if flag == "0":
+        return "split"
+    if meta_cols <= QVL:
+        # plan meta predates the QVF/QVL visit-flag columns (hand-built
+        # 13-col metas in older tests): the fused kernel cannot run
+        return "split"
+    if not fused_bwd_feasible(params, sqp, d, dv, itemsize):
+        return "split"
+    if flag == "1":
+        return "fused"
+    from .tile_policy import choose_bwd_mode
+
+    bq_dq, bk_dq = params.dq_blocks()
+    bq_dkv, bk_dkv = params.dkv_blocks()
+    w_dq = (
+        params.num_work_dq
+        if params.num_work_dq is not None
+        else params.num_work
+    )
+    wt = (
+        params.num_work_dkv
+        if params.num_work_dkv is not None
+        else params.num_work_t
+    )
+    return choose_bwd_mode(
+        w_dq, bq_dq, bk_dq, wt, bq_dkv, bk_dkv, d, dv,
+        itemsize=itemsize, group=params.group,
+    )
+
+
+def resolved_bwd_mode(
+    params: FFAParams, sqp: int, d: int, dv: int, itemsize: int = 2
+) -> str:
+    """The mode :func:`ffa_bwd_pallas_dispatch` will pick for a
+    current-layout (META_DIM-column) plan — the telemetry layer stamps
+    ``attn_step`` records' ``bwd_mode`` with this."""
+    from .ffa_plan import META_DIM
+
+    return ffa_bwd_mode(params, sqp, d, dv, itemsize, META_DIM)
+
+
+def ffa_bwd_pallas_dispatch(
+    params: FFAParams, dq_arrays, dkv_arrays, q_t, k_t, v_t, do_t, lse_t,
+    delta_t,
+):
+    """ONE backward entry for every path (custom-vjp core, mixed branches,
+    CP multi-stage, sink, dynamic): returns (dq_t, dk_t, dv_t).
+
+    Picks the fused one-pass kernel (:func:`ffa_bwd_mode`) when the env
+    flag / cost model / VMEM guard allow it, else the split dq + dkv
+    passes. A fused-kernel failure is one resilience rung ABOVE the split
+    path: with MAGI_ATTENTION_FALLBACK=1 it degrades to split (recorded as
+    a resilience event) before the calc_attn tile ladder ever engages.
+    """
+    hq, sqp, d = q_t.shape
+    dv = v_t.shape[2]
+    meta_t = dkv_arrays[2]
+    meta_cols = meta_t.shape[1] if meta_t.ndim == 2 else 0
+    mode = ffa_bwd_mode(params, sqp, d, dv, q_t.dtype.itemsize, meta_cols)
+    if mode == "fused":
+        from ..resilience import fallback as _fallback
+
+        try:
+            maybe_inject("kernel_lowering")
+            return ffa_bwd_fused_pallas_dispatch(
+                params, *dkv_arrays, q_t, k_t, v_t, do_t, lse_t, delta_t
+            )
+        except _fallback.kernel_failure_types() as e:
+            from ..env import resilience as env_resilience
+
+            if not env_resilience.is_fallback_enable():
+                raise
+            _fallback.record_resilience_event(
+                "fallback", "kernel_lowering",
+                action_detail="fused_bwd_to_split",
+                error=type(e).__name__,
+            )
+    dq_t = ffa_bwd_dq_pallas_dispatch(
+        params, *dq_arrays, q_t, k_t, v_t, do_t, lse_t, delta_t
+    )
+    dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
+        params, *dkv_arrays, q_t, k_t, v_t, do_t, lse_t, delta_t
+    )
+    return dq_t, dk_t, dv_t
+
+
+# ---------------------------------------------------------------------------
 # static kernel contracts (consumed by analysis/kernel_check.py)
 # ---------------------------------------------------------------------------
 
@@ -1760,6 +2538,42 @@ PALLAS_CONTRACTS: dict[str, dict] = {
         flush_guard="is_last",
         group_inner=None,
     ),
+    # Fused one-pass backward kernels: dk/dv follow the standard scratch
+    # discipline; dq is a REVISIT-accumulated output — no scratch run
+    # exists, the output window itself is zero-initialized under the
+    # first-q-visit guard and scale-flushed under the last-q-visit guard
+    # (K2's revisit rule). ``revisit`` names that output and its guards.
+    "_bwd_fused_kernel": dict(
+        wrapper="_ffa_bwd_fused_pallas",
+        scratch=("dk_scr", "dv_scr"),
+        outputs=("dq_ref", "dk_ref", "dv_ref"),
+        out_dtypes=("f32", "f32", "f32"),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=dict(var="gi", count="group"),
+        revisit=dict(out="dq_ref", init_guard="qvf", flush_guard="qvl"),
+    ),
+    "_bwd_fused_kernel_gqa": dict(
+        wrapper="_ffa_bwd_fused_pallas_gqa",
+        scratch=("dk_scr", "dv_scr"),
+        outputs=("dq_ref", "dk_ref", "dv_ref"),
+        out_dtypes=("f32", "f32", "f32"),
+        init_guard="is_first",
+        flush_guard="is_last",
+        group_inner=None,
+        revisit=dict(out="dq_ref", init_guard="qvf", flush_guard="qvl"),
+    ),
+    # Delta preprocessing: stateless map kernel — every grid step writes
+    # its own block once, so there is no accumulator discipline to prove.
+    "_delta_kernel": dict(
+        wrapper="_ffa_delta_pallas",
+        scratch=(),
+        outputs=("delta_ref",),
+        out_dtypes=("f32",),
+        init_guard=None,
+        flush_guard=None,
+        group_inner=None,
+    ),
 }
 
 
@@ -1825,14 +2639,11 @@ def _ffa_core_bwd(params: FFAParams, res, cts):
     q_t, k_t, v_t, out_t, lse_t, arrays = res
     kc, vc = k_t.astype(q_t.dtype), v_t.astype(q_t.dtype)
     dq_arrays, dkv_arrays = _bwd_plan_slices(arrays)
-    delta_t = jnp.sum(
-        do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
-    )  # (hq, sqp)
-    dq_t = ffa_bwd_dq_pallas_dispatch(
-        params, *dq_arrays, q_t, kc, vc, do_t, lse_t, delta_t
-    )
-    dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
-        params, *dkv_arrays, q_t, kc, vc, do_t, lse_t, delta_t,
+    # delta = rowsum(dO ⊙ O) via the shared Pallas delta kernel — no XLA
+    # full-array pass over o/do
+    delta_t = ffa_delta_pallas_dispatch(params, out_t, do_t)  # (hq, sqp)
+    dq_t, dk_t, dv_t = ffa_bwd_pallas_dispatch(
+        params, dq_arrays, dkv_arrays, q_t, kc, vc, do_t, lse_t, delta_t,
     )
     # dk/dv already come back per kv head: the dkv kernel accumulates the
     # GQA group in-kernel (no host reshape-sum). The kernels emit fp32; the
@@ -2075,9 +2886,14 @@ def _ffa_mixed_bwd(params_a: FFAParams, params_b: FFAParams, res, cts):
     q, k, v, out, lse, arrays_a, arrays_b = res
     sq, sk = q.shape[0], k.shape[0]
     do = do.astype(q.dtype)
-    delta = jnp.sum(
-        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (sq, hq)
+    # delta via the shared Pallas delta kernel, computed ONCE on branch
+    # a's padded geometry and sliced back to seq-major — both branches
+    # consume the same merged delta, and padded do rows are zero so their
+    # delta is exactly 0 (matching the old zero padding per branch)
+    sqp_a = params_a.num_q_tiles * params_a.block_q
+    out_h = jnp.pad(out, ((0, sqp_a - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
+    do_h = jnp.pad(do, ((0, sqp_a - sq), (0, 0), (0, 0))).transpose(1, 0, 2)
+    delta = ffa_delta_pallas_dispatch(params_a, out_h, do_h).T[:sq]  # (sq, hq)
 
     def branch(arrays, params: FFAParams):
         sqp = params.num_q_tiles * params.block_q
@@ -2095,11 +2911,9 @@ def _ffa_mixed_bwd(params_a: FFAParams, params_b: FFAParams, res, cts):
         ).T
         delta_t = jnp.pad(delta, ((0, sqp - sq), (0, 0))).T
         dq_arrays, dkv_arrays = _bwd_plan_slices(arrays)
-        dq_t = ffa_bwd_dq_pallas_dispatch(
-            params, *dq_arrays, q_t, kc, vc, do_t, lse_t, delta_t
-        )
-        dk_t, dv_t = ffa_bwd_dkv_pallas_dispatch(
-            params, *dkv_arrays, q_t, kc, vc, do_t, lse_t, delta_t
+        dq_t, dk_t, dv_t = ffa_bwd_pallas_dispatch(
+            params, dq_arrays, dkv_arrays, q_t, kc, vc, do_t, lse_t,
+            delta_t,
         )
         return (
             dq_t.transpose(1, 0, 2)[:sq],

@@ -29,9 +29,16 @@ from ..utils.profiling import instrument_host
 # columns appended host-side by :func:`_extend_meta_extents` — the exact
 # sub-rectangle of the tile the band touches, rounded out to the hardware
 # quanta, consumed by the extent-clamped kernel bodies (kernels/ffa.py).
+# QVF/QVL mark the first/last occurrence of the item's q tile across the
+# WHOLE list (appended by :func:`_extend_meta_visits`): on the k-major list
+# a q tile's visits are non-consecutive, and the fused one-pass backward
+# zero-initializes its revisited dq output block on QVF and flushes
+# (applies softmax_scale) on QVL. On the q-major list a q tile's items form
+# one contiguous run, so there QVF/QVL coincide with IS_FIRST/IS_LAST.
 QS, QE, KS, KE, DLO, DHI, IS_FIRST, IS_LAST, IS_FULL = range(9)
 EQ0, EQ1, EK0, EK1 = 9, 10, 11, 12
-META_DIM = 13
+QVF, QVL = 13, 14
+META_DIM = 15
 # rounding quanta for the live extents: q rows land in the sublane dim
 # (fp32 register tiling), k cols in the lane dim
 SUBLANE_QUANTUM = 8
@@ -113,6 +120,35 @@ def _extend_meta_extents(
     empty = (i0 >= i1) | (j0 >= j1) | (q1 <= q0) | (k1 <= k0)
     ext[empty] = 0
     return np.concatenate([meta9, ext.astype(np.int32)], axis=1)
+
+
+def _extend_meta_visits(meta13: np.ndarray, work_qt: np.ndarray) -> np.ndarray:
+    """Append the q-visit flag columns QVF/QVL to 13-col meta rows.
+
+    QVF (resp. QVL) is 1 on the row where the item's q tile appears for the
+    first (resp. last) time in this list — across the WHOLE list, not per
+    run, which is what makes them usable from the k-major traversal where a
+    q tile's visits are interleaved with other q tiles. Dummy items count
+    as visits (their contribution is zero, so an init or flush landing on
+    one is benign); ``pad_plan`` filler is appended after the fact with
+    QVF = QVL = 0 so the real flush row keeps the flag.
+    """
+    w = np.asarray(work_qt)
+    n = len(w)
+    qvf = np.zeros(n, dtype=np.int32)
+    qvl = np.zeros(n, dtype=np.int32)
+    if n:
+        first_idx: dict[int, int] = {}
+        last_idx: dict[int, int] = {}
+        for i, qt in enumerate(w.tolist()):
+            if qt not in first_idx:
+                first_idx[qt] = i
+            last_idx[qt] = i
+        qvf[list(first_idx.values())] = 1
+        qvl[list(last_idx.values())] = 1
+    return np.concatenate(
+        [meta13, np.stack([qvf, qvl], axis=1)], axis=1
+    ).astype(np.int32)
 
 
 def plan_extent_stats(plan: FFAPlan) -> dict:
@@ -260,17 +296,23 @@ def build_ffa_plan(
                 num_q_tiles, num_k_tiles, block_q, block_k, BAND_INF,
             )
             # the C fill writes 9-col rows (fixed stride, csrc/magi_host.cpp);
-            # the extent columns are appended here so native and Python
-            # plans stay bit-identical
+            # the extent and q-visit columns are appended here so native
+            # and Python plans stay bit-identical
             return _record_plan_telemetry(
                 FFAPlan(
                     work_qt=arrays[0], work_kt=arrays[1],
-                    meta=_extend_meta_extents(
-                        arrays[2], arrays[0], arrays[1], block_q, block_k
+                    meta=_extend_meta_visits(
+                        _extend_meta_extents(
+                            arrays[2], arrays[0], arrays[1], block_q, block_k
+                        ),
+                        arrays[0],
                     ),
                     work_qt_t=arrays[3], work_kt_t=arrays[4],
-                    meta_t=_extend_meta_extents(
-                        arrays[5], arrays[3], arrays[4], block_q, block_k
+                    meta_t=_extend_meta_visits(
+                        _extend_meta_extents(
+                            arrays[5], arrays[3], arrays[4], block_q, block_k
+                        ),
+                        arrays[3],
                     ),
                     num_q_tiles=num_q_tiles, num_k_tiles=num_k_tiles,
                     block_q=block_q, block_k=block_k,
@@ -354,7 +396,10 @@ def build_ffa_plan(
         return (
             work_a,
             work_b,
-            _extend_meta_extents(meta9, work_a, work_b, block_q, block_k),
+            _extend_meta_visits(
+                _extend_meta_extents(meta9, work_a, work_b, block_q, block_k),
+                work_a,
+            ),
         )
 
     work_qt, work_kt, meta = flatten(q_items, major_is_q=True)
@@ -393,7 +438,9 @@ def pad_plan(plan: FFAPlan, num_work: int, num_work_t: int) -> FFAPlan:
         pb = np.full(pad_n, work_b[-1], dtype=np.int32)
         # filler rows keep the all-zero live extent (EQ0..EK1 == 0): the
         # clamp path skips them and plan_extent_stats excludes them from
-        # the padded/executed accounting (QS == QE flags them as non-real)
+        # the padded/executed accounting (QS == QE flags them as non-real).
+        # QVF/QVL stay 0 too — filler revisits the last real tile's dq
+        # window with a zero contribution, after its real flush row
         pm = np.zeros((pad_n, META_DIM), dtype=np.int32)
         pm[:, DLO], pm[:, DHI] = -BAND_INF, BAND_INF
         return (

@@ -88,8 +88,10 @@ def ffa_kernel_residency(
     unpacked kernels are per-q-head, so ``group`` is ignored for them
     except dkv's lse/delta sublane layout which is group-independent.
     """
-    if kind not in ("fwd", "dq", "dkv"):
-        raise ValueError(f"kind must be 'fwd'|'dq'|'dkv', got {kind!r}")
+    if kind not in ("fwd", "dq", "dkv", "fused", "delta"):
+        raise ValueError(
+            f"kind must be 'fwd'|'dq'|'dkv'|'fused'|'delta', got {kind!r}"
+        )
     dv = head_dim_v or head_dim
     g = group if packed else 1
     bq, bk, d = block_q, block_k, head_dim
@@ -113,7 +115,7 @@ def ffa_kernel_residency(
         blocks += g * bq * d * f32  # dq out (fp32)
         scratch = g * bq * d * f32
         inter = 2 * g * bq * bk * f32  # s + dp
-    else:  # dkv
+    elif kind == "dkv":
         blocks = q_in + k_in + v_in
         blocks += g * bq * dv * dtype_bytes  # do
         # lse/delta: packed rides (1, g*bq) rows; unpacked an (8, bq) slab
@@ -121,6 +123,24 @@ def ffa_kernel_residency(
         blocks += (bk * d + bk * dv) * f32  # dk + dv outs (fp32)
         scratch = (bk * d + bk * dv) * f32
         inter = 2 * g * bq * bk * f32  # s_t + dp_t
+    elif kind == "fused":
+        # one-pass backward: the dkv residency PLUS the revisited dq
+        # output window and its aliased zero-background input block (both
+        # fp32, both declared BlockSpecs so both pipeline-double-buffered)
+        blocks = q_in + k_in + v_in
+        blocks += g * bq * dv * dtype_bytes  # do
+        blocks += 2 * (g * bq if packed else 8 * bq) * f32  # lse + delta
+        blocks += (bk * d + bk * dv) * f32  # dk + dv outs (fp32)
+        blocks += 2 * g * bq * d * f32  # dq out + aliased dqz in (fp32)
+        scratch = (bk * d + bk * dv) * f32
+        inter = 2 * g * bq * bk * f32  # s_t + dp_t
+    else:  # delta
+        # stateless rowsum(dO ⊙ O) map kernel: o + do blocks in, one
+        # lanes-broadcast fp32 block out, no scratch; group-independent
+        blocks = 2 * bq * dv * dtype_bytes  # o + do
+        blocks += bq * 128 * f32  # delta (lanes-broadcast)
+        scratch = 0
+        inter = bq * dv * f32  # fp32 elementwise product
     total = 2 * blocks + scratch
     if include_intermediates:
         total += inter
@@ -137,6 +157,6 @@ def ffa_max_total_seqlen(
     """Upper bound on the merged kv length whose *index metadata* fits the
     scalar-prefetch budget (the payload streams from HBM, so the real bound
     is plan size, not seqlen)."""
-    per_item = 13 * 4 + 2 * 4  # meta row (9 band + 4 extent cols) + two work indices
+    per_item = 15 * 4 + 2 * 4  # meta row (9 band + 4 extent + 2 q-visit cols) + two work indices
     max_items = max(1, vmem_bytes // (8 * per_item))
     return max_items * block_k

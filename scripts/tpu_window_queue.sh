@@ -80,8 +80,15 @@ run_queue() {
     run_step 900 ".tpu_logs/${TS}_smoke.log" python -u scripts/tpu_smoke.py || return
     grep -q "^SMOKE PASS" ".tpu_logs/${TS}_smoke.log" && touch "$SMOKE_STAMP"
   fi
-  # GQA-packed dkv backward A/B — THE decisive measurement for this
-  # round's tentpole. Pre-registered expectation: packed dkv lifts GQA
+  # fused one-pass backward A/B — THE decisive measurement for the
+  # fused-bwd tentpole. Pre-registered expectation: the 7 -> 5 tile-matmul
+  # drop plus halved q/k/v/do streaming lifts fwd+bwd toward the >= 60%
+  # MFU target (r8 baseline 89.2 TF/s = 45.3% with split passes). Split
+  # vs fused at 4096/8192/16384 per family -> bench_bwd.csv, each arm
+  # floored at its OWN executed-matmul physics.
+  run_step 1800 ".tpu_logs/${TS}_bwd_fused_ab.log" python -u bench.py --bwd-suite || return
+  # GQA-packed dkv backward A/B — the prior round's tentpole measurement.
+  # Pre-registered expectation: packed dkv lifts GQA
   # fwd+bwd to >= 110 TF/s reference-convention (r5 baseline 77.3 TF/s;
   # fwd pack measured 138). 2x2 arms (dkv_pack x tiling) all append to
   # bwd_override_sweep.csv; the env-tiling pair runs first because it

@@ -12,6 +12,8 @@ import pytest
 
 from magiattention_tpu.analysis.kernel_check import (
     _TOY_CONTRACTS,
+    _TOY_FUSED_CONTRACTS,
+    _TOY_FUSED_KERNEL_SRC,
     _TOY_KERNEL_SRC,
     K5_ALLOWLIST,
     capture_ffa_contracts,
@@ -32,7 +34,7 @@ from magiattention_tpu.kernels.ffa import PALLAS_CONTRACTS
 
 def test_discovery_finds_every_pallas_site():
     sites = discover_pallas_sites()
-    assert len(sites) == 6
+    assert len(sites) == 9
     names = {s.kernel_name for s in sites}
     assert names == set(PALLAS_CONTRACTS)
     assert all(s.relpath == "kernels/ffa.py" for s in sites)
@@ -55,6 +57,31 @@ def test_toy_kernel_source_is_clean():
     assert report.fired_rules() == set()
 
 
+def test_toy_fused_kernel_source_is_clean():
+    # base case for the deleted_revisit_init mutation: the clean fused
+    # toy (scratch accumulator + revisit-accumulated output) must satisfy
+    # every K2 discipline rule including the qvf/qvl revisit rules
+    report = VerifyReport()
+    check_kernel_sources(
+        report, _TOY_FUSED_KERNEL_SRC, _TOY_FUSED_CONTRACTS, "toy.py"
+    )
+    assert report.fired_rules() == set()
+
+
+def test_revisit_overwrite_outside_guards_fires_k2():
+    # a plain Assign to the revisit output outside the qvf/qvl blocks
+    # would overwrite earlier work items' contributions on a revisit
+    src = _TOY_FUSED_KERNEL_SRC.replace(
+        "    dq_ref[0] += contrib", "    dq_ref[0] = contrib"
+    )
+    report = VerifyReport()
+    check_kernel_sources(report, src, _TOY_FUSED_CONTRACTS, "toy.py")
+    assert report.fired_rules() == {"K2"}
+    assert any(
+        "overwrite, not accumulate" in v.detail for v in report.violations
+    )
+
+
 # -- K5 on the real repo ----------------------------------------------------
 
 
@@ -75,11 +102,13 @@ def test_k5_allowlist_entries_carry_a_proof():
 
 def test_seeded_mutations_fire_exactly_their_rule():
     results = run_seeded_mutations()
-    assert len(results) == 6
+    assert len(results) == 7
     assert {r["expected_rule"] for r in results} == {
         "K1", "K2", "K3", "K4", "K5"
     }
-    assert {r["mutation"] for r in results} >= {"corrupted_extent_row"}
+    assert {r["mutation"] for r in results} >= {
+        "corrupted_extent_row", "deleted_revisit_init"
+    }
     for r in results:
         assert r["ok"], (
             f"mutation {r['mutation']} expected {{'{r['expected_rule']}'}} "

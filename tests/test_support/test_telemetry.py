@@ -106,6 +106,8 @@ def test_step_emits_schema_records(tmp_path, monkeypatch):
     # estimated (band) vs executed (padded-grid) work
     assert step["padded_elems"] >= step["band_elems"] > 0
     assert step["padded_flops_fwd"] >= step["est_flops_fwd"] > 0
+    # resolved backward execution mode rides every ffa attn_step
+    assert step["bwd_mode"] in ("fused", "split")
 
     # runtime cache counters rode along
     cache = [r for r in records if r["kind"] == "runtime_cache"][-1]
@@ -174,7 +176,7 @@ def test_kernel_audit_report_round_trip(tmp_path, monkeypatch, capsys):
     agg = mod.aggregate(mod.load_records([str(tmp_path)]))
     ka = agg["kernel_audit"]
     assert ka["runs"] == 1
-    assert ka["kernels"] == 6
+    assert ka["kernels"] == 9
     assert ka["configs"] >= 1
     assert ka["rules_run"] == ["K1", "K2", "K3", "K4", "K5"]
     assert ka["errors_total"] == 0 and ka["warnings_total"] == 0
