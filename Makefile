@@ -4,9 +4,9 @@
 PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
-	telemetry-smoke analysis lint verify-plans kernel-audit chaos
+	telemetry-smoke analysis lint verify-plans kernel-audit chaos serve-smoke
 
-test: analysis chaos  ## fast tier: the correctness surface in < 5 min on one core
+test: analysis chaos serve-smoke  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 test-all: analysis  ## everything: + model training, scale oracles, property suites
@@ -47,3 +47,6 @@ telemetry-smoke:  ## CPU single-step telemetry round trip (JSONL -> report)
 
 chaos:  ## fault-injection chaos matrix: every site recovers or raises typed
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience -x -q -m chaos
+
+serve-smoke:  ## CPU continuous-batching end-to-end: engine bitwise vs replay
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py

@@ -1,0 +1,157 @@
+"""Serving-runtime benchmark: continuous batching latency distribution.
+
+Drives :class:`~magiattention_tpu.serving.ServeEngine` over a synthetic
+ragged workload and reports per-request latency statistics — time to first
+token (admission wait + prefill) and per-token decode latency — as text
+histograms, appending a summary row to
+``benchmarks/history/bench_serve.csv`` (same append-only convention as
+the other perf history files).
+
+On a TPU chip this measures the real paged-decode kernel; on CPU the
+kernels run in interpret mode, so the numbers are relative-cost smoke
+only (the scheduler/cache overheads are still real host work).
+
+    python benchmarks/serve_bench.py --requests 16 --slots 4 --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def histogram(values: list[float], title: str, bins: int = 8) -> str:
+    """Fixed-width text histogram of latencies in milliseconds."""
+    lines = [f"{title} (n={len(values)})"]
+    if not values:
+        return lines[0] + ": no samples"
+    arr = np.asarray(values)
+    lines.append(
+        f"  p50={np.percentile(arr, 50):.2f} ms "
+        f"p90={np.percentile(arr, 90):.2f} ms "
+        f"p99={np.percentile(arr, 99):.2f} ms "
+        f"max={arr.max():.2f} ms"
+    )
+    lo, hi = float(arr.min()), float(arr.max())
+    if hi <= lo:
+        hi = lo + 1e-6
+    counts, edges = np.histogram(arr, bins=bins, range=(lo, hi))
+    peak = max(int(counts.max()), 1)
+    for count, left, right in zip(counts, edges[:-1], edges[1:]):
+        bar = "#" * max(1 if count else 0, round(40 * count / peak))
+        lines.append(f"  [{left:9.2f}, {right:9.2f}) {count:4d} {bar}")
+    return "\n".join(lines)
+
+
+def make_workload(model, num_requests: int, seed: int):
+    from magiattention_tpu.serving import ServeRequest
+
+    rng = np.random.default_rng(seed)
+    requests = []
+    for i in range(num_requests):
+        prompt_len = int(rng.integers(4, 64))
+        new_tokens = int(rng.integers(2, 12))
+        requests.append(
+            ServeRequest(
+                req_id=i,
+                prompt=model.prompt(length=prompt_len, seed=1000 + i),
+                max_new_tokens=new_tokens,
+            )
+        )
+    return requests
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--pages", type=int, default=48)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force JAX_PLATFORMS=cpu (interpret-mode kernels)")
+    ap.add_argument("--no-history", action="store_true",
+                    help="skip the bench_serve.csv append")
+    args = ap.parse_args()
+
+    if args.cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from magiattention_tpu.benchmarking.perf_report import append_row
+    from magiattention_tpu.serving import ServeConfig, ServeEngine, ToyModel
+
+    model = ToyModel.create()
+    config = ServeConfig(
+        page_size=args.page_size,
+        num_pages=args.pages,
+        max_slots=args.slots,
+        max_pages_per_seq=max(
+            1, -(-((64 + 16) * 1) // args.page_size)  # longest prompt + gen
+        ),
+        prefill_chunk=args.prefill_chunk,
+    )
+    requests = make_workload(model, args.requests, args.seed)
+    total_new = sum(r.max_new_tokens for r in requests)
+
+    engine = ServeEngine(model, config)
+    finished = engine.run(requests)
+
+    ttft = [
+        (r.first_token_time - r.submit_time) * 1e3
+        for r in finished
+        if r.first_token_time is not None and r.submit_time is not None
+    ]
+    total = [
+        (r.finish_time - r.submit_time) * 1e3
+        for r in finished
+        if r.finish_time is not None and r.submit_time is not None
+    ]
+    per_token = [
+        t / r.max_new_tokens for t, r in zip(total, finished)
+    ]
+    evictions = sum(r.evictions for r in requests)
+
+    print(
+        f"serve bench: {len(finished)}/{len(requests)} requests, "
+        f"{total_new} new tokens in {engine.step_count} steps "
+        f"({evictions} evictions, slots={args.slots}, "
+        f"pages={args.pages}x{args.page_size})"
+    )
+    print(histogram(ttft, "time to first token"))
+    print(histogram(total, "request latency"))
+    print(histogram(per_token, "amortized per-token latency"))
+
+    if not args.no_history:
+        append_row(
+            "bench_serve",
+            {
+                "metric": "serve_continuous_batching",
+                "requests": len(finished),
+                "slots": args.slots,
+                "pages": args.pages,
+                "page_size": args.page_size,
+                "steps": engine.step_count,
+                "evictions": evictions,
+                "new_tokens": total_new,
+                "ttft_p50_ms": round(float(np.percentile(ttft, 50)), 3),
+                "ttft_p99_ms": round(float(np.percentile(ttft, 99)), 3),
+                "latency_p50_ms": round(float(np.percentile(total, 50)), 3),
+                "latency_p99_ms": round(float(np.percentile(total, 99)), 3),
+                "per_token_p50_ms": round(
+                    float(np.percentile(per_token, 50)), 3
+                ),
+            },
+        )
+        print("appended benchmarks/history/bench_serve.csv")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,9 +61,28 @@ def __getattr__(name):
         "InjectedFault",
         "NumericGuardError",
         "FallbackExhaustedError",
+        "PageExhaustedError",
         "UnknownLoweringError",
     ):
         from . import resilience
 
         return getattr(resilience, name)
+    # paged-KV / serving names (kernels/paged_kv.py, kernels/paged_decode.py,
+    # serving/): lazy for the same reason as the api block
+    if name in (
+        "PagedKVCache",
+        "paged_attn",
+        "paged_decode_attn",
+    ):
+        from . import kernels
+
+        return getattr(kernels, name)
+    if name in (
+        "ServeConfig",
+        "ServeEngine",
+        "ServeRequest",
+    ):
+        from . import serving
+
+        return getattr(serving, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

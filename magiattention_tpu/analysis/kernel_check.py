@@ -481,6 +481,85 @@ def capture_ffa_contracts(spec: AuditSpec) -> list[KernelContract]:
     return contracts
 
 
+@dataclass(frozen=True, eq=False)
+class DecodeAuditSpec:
+    """One paged-decode corpus configuration (kernels/paged_decode.py)."""
+
+    name: str
+    max_seqs: int = 4
+    pages_per_seq: int = 8
+    num_pages: int = 32
+    page_size: int = 128
+    hq: int = 4
+    hk: int = 2
+    d: int = 128
+    dv: int = 128
+    dtype: str = "bfloat16"
+    lengths: tuple[int, ...] | None = None
+
+
+def decode_corpus() -> list[DecodeAuditSpec]:
+    """Configs the decode kernel is captured at: the serving default, a
+    wide-page fp32 variant, and a ragged batch with dead slots + partially
+    allocated page-table rows (-1 entries exercise the clamp index map)."""
+    return [
+        DecodeAuditSpec(name="decode/bfloat16/g2/ps128"),
+        DecodeAuditSpec(
+            name="decode/float32/g1/ps256", dtype="float32",
+            hq=2, page_size=256, num_pages=16, pages_per_seq=4,
+        ),
+        DecodeAuditSpec(
+            name="decode/bfloat16/g4/ragged", hq=8,
+            lengths=(5, 0, 259, 128),
+        ),
+    ]
+
+
+def capture_decode_contracts(spec: DecodeAuditSpec) -> list[KernelContract]:
+    """Drive the paged-decode wrapper under capture at ``spec``: a cache
+    whose page table is allocated exactly as the serving allocator would
+    (pages in order per slot, -1 beyond each slot's allocation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import paged_decode
+    from ..kernels.paged_kv import PagedKVCache
+
+    ps = spec.page_size
+    lengths = spec.lengths
+    if lengths is None:
+        lengths = tuple(
+            min((i + 1) * ps, spec.pages_per_seq * ps)
+            for i in range(spec.max_seqs)
+        )
+    table = np.full((spec.max_seqs, spec.pages_per_seq), -1, np.int32)
+    nxt = 0
+    for s, ln in enumerate(lengths):
+        for j in range(-(-ln // ps)):
+            table[s, j] = nxt % spec.num_pages
+            nxt += 1
+    dtype = jnp.dtype(spec.dtype)
+    cache = PagedKVCache(
+        k_pages=jnp.zeros(
+            (spec.num_pages, ps, spec.hk, spec.d), dtype
+        ),
+        v_pages=jnp.zeros(
+            (spec.num_pages, ps, spec.hk, spec.dv), dtype
+        ),
+        page_table=jnp.asarray(table),
+        lengths=jnp.asarray(np.asarray(lengths, np.int32)),
+    )
+    q = jnp.zeros((spec.max_seqs, spec.hq, spec.d), dtype)
+    cap = _capture_pallas()
+    with jax.default_device(jax.devices("cpu")[0]):
+        with cap:
+            try:
+                paged_decode.paged_decode_attn(q, cache)
+            except _Captured:
+                pass
+    return cap.contracts
+
+
 # ---------------------------------------------------------------------------
 # contract geometry helpers
 # ---------------------------------------------------------------------------
@@ -499,6 +578,19 @@ def _contract_shape_info(contract: KernelContract) -> dict:
             kind="delta", packed=False, g=1,
             bq=int(o_block[1]), bk=0,
             d=int(o_block[2]), dv=int(o_block[2]),
+            itemsize=np.dtype(contract.operands[0][1]).itemsize,
+            emit_ml=False,
+        )
+    if "decode" in name:
+        # paged-decode kernel: q block (1, 1, g, d), k/v blocks
+        # (1, page_size, 1, d|dv); bq = group rows, bk = page size
+        q_block = contract.in_specs[0].block_shape
+        k_block = contract.in_specs[1].block_shape
+        v_block = contract.in_specs[2].block_shape
+        return dict(
+            kind="decode", packed=False, g=1,
+            bq=int(q_block[2]), bk=int(k_block[1]),
+            d=int(q_block[3]), dv=int(v_block[3]),
             itemsize=np.dtype(contract.operands[0][1]).itemsize,
             emit_ml=False,
         )
@@ -885,9 +977,27 @@ def check_k4_dtypes(
 
 
 def _pallas_contracts() -> dict:
-    from ..kernels.ffa import PALLAS_CONTRACTS
+    from ..kernels.ffa import PALLAS_CONTRACTS as ffa_contracts
+    from ..kernels.paged_decode import PALLAS_CONTRACTS as decode_contracts
 
-    return PALLAS_CONTRACTS
+    return {**ffa_contracts, **decode_contracts}
+
+
+def _contract_sources() -> list[tuple[str, str, dict]]:
+    """(relpath, source, contracts) for every kernel module that declares
+    PALLAS_CONTRACTS — the K2/K4 source-rule sweep iterates these."""
+    from ..kernels.ffa import PALLAS_CONTRACTS as ffa_contracts
+    from ..kernels.paged_decode import PALLAS_CONTRACTS as decode_contracts
+
+    kdir = _kernels_dir()
+    return [
+        ("kernels/ffa.py", (kdir / "ffa.py").read_text(), ffa_contracts),
+        (
+            "kernels/paged_decode.py",
+            (kdir / "paged_decode.py").read_text(),
+            decode_contracts,
+        ),
+    ]
 
 
 def check_contract(
@@ -969,14 +1079,28 @@ def check_kernel_sources(
     relpath: str = "kernels/ffa.py",
 ) -> None:
     """K2 (+ the source half of K4) over the kernel bodies declared in
-    ``PALLAS_CONTRACTS``. ``source``/``contracts`` default to the real
-    ``kernels/ffa.py``; tests pass mutated fixtures."""
-    report.mark_run("K2")
-    report.mark_run("K4")
+    ``PALLAS_CONTRACTS``. With no ``source``/``contracts`` the sweep covers
+    every kernel module in :func:`_contract_sources`; tests pass mutated
+    fixtures explicitly."""
+    if source is None and contracts is None:
+        for rel, src, decls in _contract_sources():
+            _check_kernel_sources_one(report, src, decls, rel)
+        return
     if contracts is None:
         contracts = _pallas_contracts()
     if source is None:
         source = (_kernels_dir() / "ffa.py").read_text()
+    _check_kernel_sources_one(report, source, contracts, relpath)
+
+
+def _check_kernel_sources_one(
+    report: VerifyReport,
+    source: str,
+    contracts: dict,
+    relpath: str,
+) -> None:
+    report.mark_run("K2")
+    report.mark_run("K4")
     tree = ast.parse(source)
     fns = {
         node.name: node
@@ -1037,7 +1161,13 @@ def check_kernel_sources(
                 and isinstance(node.targets[0], ast.Name)
             ):
                 bindings[node.targets[0].id] = ast.unparse(node.value)
-        guard_cols = [(init_guard, "IS_FIRST"), (flush_guard, "IS_LAST")]
+        # guard-binding provenance: plan-meta kernels bind from IS_FIRST /
+        # IS_LAST columns; grid-axis kernels (the paged-decode page run)
+        # declare their expected binding substrings explicitly
+        guard_cols = [
+            (init_guard, decl.get("init_binding", "IS_FIRST")),
+            (flush_guard, decl.get("flush_binding", "IS_LAST")),
+        ]
         if revisit:
             guard_cols += [
                 (revisit["init_guard"], "QVF"),
@@ -1505,6 +1635,28 @@ def run_kernel_audit(
             row.update(padding_stats(contract, spec.sq, spec.sk))
             rows.append(row)
 
+    # paged-decode corpus: no plan metadata (padding_stats does not apply —
+    # the page grid is dense by construction; dead pages are length-masked)
+    for dspec in decode_corpus():
+        for contract in capture_decode_contracts(dspec):
+            captured_kernels.add(contract.kernel_name)
+            site = f"{dspec.name}:{contract.kernel_name}"
+            check_contract(report, contract, site)
+            info = _contract_shape_info(contract)
+            rows.append(
+                {
+                    "config": dspec.name,
+                    "kernel": contract.kernel_name,
+                    "grid": list(contract.grid),
+                    "vmem_bytes": _declared_bytes(contract),
+                    "vmem_total_bytes": ffa_kernel_residency(
+                        info["kind"], info["bq"], info["bk"], info["d"],
+                        head_dim_v=info["dv"], dtype_bytes=info["itemsize"],
+                    ),
+                    "vmem_allowed_bytes": VMEM_ALLOWED_BYTES,
+                }
+            )
+
     site_kernels = {
         s.kernel_name for s in sites if s.kernel_name in declared
     }
@@ -1716,6 +1868,23 @@ def run_seeded_mutations() -> list[dict]:
             "mutation.py",
         )
 
+    def oob_page_table(report: VerifyReport) -> None:
+        # point one page-table entry one past the last page: gather_kv's
+        # maximum(table, 0) clamp only rescues -1 sentinels, so an
+        # oversized id escapes the k/v operands — only the K3 index-map
+        # bounds eval over the real prefetch can catch it
+        dbase = next(
+            c for c in capture_decode_contracts(decode_corpus()[0])
+            if c.kernel_name == "_paged_decode_kernel"
+        )
+        num_pages = dbase.operands[1][0][0]  # k_pages page axis
+        table = dbase.prefetch[0].copy()
+        table[0, 0] = num_pages
+        mut = replace(
+            dbase, prefetch=(table,) + tuple(dbase.prefetch[1:])
+        )
+        check_contract(report, mut, "mutation:oob_page_table")
+
     run("oversized_scratch", "K1", oversized)
     run("swapped_index_map_axes", "K3", swapped)
     run("missing_accumulator_init", "K2", no_init)
@@ -1723,4 +1892,5 @@ def run_seeded_mutations() -> list[dict]:
     run("bf16_accumulator", "K4", bf16_scratch)
     run("unlisted_env_key", "K5", unlisted_key)
     run("corrupted_extent_row", "K3", bad_extent)
+    run("oob_page_table", "K3", oob_page_table)
     return results

@@ -1,0 +1,47 @@
+"""Serving-runtime toggles (docs/serving.md).
+
+Knobs for the continuous-batching loop in :mod:`magiattention_tpu.serving`.
+All are read through typed getters (lint rule MAGI-L001) and documented in
+docs/env_variables.md (lint rule MAGI-L006). None of these keys is consumed
+under kernels/ (rule K5): routing happens in serving/decode.py, above the
+kernel layer.
+"""
+
+from __future__ import annotations
+
+from .general import _get_int, _get_str
+
+
+def serve_decode_kernel() -> str:
+    """Decode-attention rung selection for serving/decode.py:
+    ``auto`` (default) — start at the Pallas paged-decode kernel and let
+    the fallback ladder descend on failure; ``1`` — same start, kept for
+    symmetry with the ffa tri-states; ``0`` — pin the gather+FFA reference
+    rung (the serve-smoke bitwise-equality configuration)."""
+    val = _get_str("MAGI_ATTENTION_SERVE_DECODE_KERNEL", "auto").lower()
+    return val if val in ("auto", "1", "0") else "auto"
+
+
+def serve_max_slots() -> int:
+    """Default static batch-slot count for ServeConfig.from_env (the
+    engine's batch shapes are fixed at construction; requests beyond this
+    wait in the admission queue)."""
+    return _get_int("MAGI_ATTENTION_SERVE_MAX_SLOTS", 4)
+
+
+def serve_num_pages() -> int:
+    """Default KV page-pool size for ServeConfig.from_env — the page
+    budget admission/eviction operates under."""
+    return _get_int("MAGI_ATTENTION_SERVE_PAGES", 64)
+
+
+def serve_page_size() -> int:
+    """Default tokens per KV page for ServeConfig.from_env."""
+    return _get_int("MAGI_ATTENTION_SERVE_PAGE_SIZE", 16)
+
+
+def serve_prefill_chunk() -> int:
+    """Default prefill chunk length (tokens per FFA call) for
+    ServeConfig.from_env; prompts are prefilled in chunks of this size
+    interleaved with decode steps."""
+    return _get_int("MAGI_ATTENTION_SERVE_PREFILL_CHUNK", 64)

@@ -1,1 +1,19 @@
 """Device compute kernels: Pallas FFA + jnp reference backends."""
+
+from .paged_decode import paged_decode_attn  # noqa: F401
+from .paged_kv import (  # noqa: F401
+    PagedKVCache,
+    append_kv,
+    assign_pages,
+    gather_kv,
+    paged_attn,
+)
+
+__all__ = [
+    "PagedKVCache",
+    "append_kv",
+    "assign_pages",
+    "gather_kv",
+    "paged_attn",
+    "paged_decode_attn",
+]

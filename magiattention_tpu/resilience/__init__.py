@@ -11,6 +11,7 @@ from .errors import (
     FaultSpecError,
     InjectedFault,
     NumericGuardError,
+    PageExhaustedError,
     ResilienceError,
     UnknownLoweringError,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "InjectedFault",
     "NumericGuardError",
     "FallbackExhaustedError",
+    "PageExhaustedError",
     "UnknownLoweringError",
     "check_outputs",
     "INJECTION_SITES",

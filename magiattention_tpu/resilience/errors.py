@@ -54,6 +54,20 @@ class FallbackExhaustedError(ResilienceError):
     reference path. Chains from the first failure via __cause__."""
 
 
+class PageExhaustedError(ResilienceError):
+    """The serving page pool cannot satisfy an allocation and no request
+    is evictable (serving/cache.py PagePool). Carries ``requested`` and
+    ``free`` so admission control and tests can assert the deficit."""
+
+    def __init__(self, requested: int, free: int) -> None:
+        self.requested = requested
+        self.free = free
+        super().__init__(
+            f"KV page pool exhausted: requested {requested} page(s) with "
+            f"{free} free and nothing evictable"
+        )
+
+
 class UnknownLoweringError(ResilienceError, ValueError):
     """A comm dispatcher received a lowering kind it does not implement
     (comm/primitives.py cast_rows/reduce_rows) — silently running the

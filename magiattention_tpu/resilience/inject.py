@@ -45,6 +45,7 @@ INJECTION_SITES: tuple[str, ...] = (
     "dynamic_plan_solve",  # qo-comm planner (meta/_make_attn_meta.py)
     "comm_plan_build",    # static comm-plan build (meta/_make_attn_meta.py)
     "nan_output",         # post-kernel output corruption (resilience/fallback.py)
+    "serve_decode",       # paged-decode serving rung (serving/decode.py)
 )
 
 

@@ -1,0 +1,68 @@
+"""Host-side KV page pool + cache slot lifecycle (serving layer).
+
+The device cache is :class:`~..kernels.paged_kv.PagedKVCache` (functional,
+jit-safe); this module owns the HOST bookkeeping around it: which pages are
+free, how many a request needs, and resetting a slot's table row when a
+request finishes or is evicted. Allocation order is deterministic (FIFO
+free list), which is what makes slot reuse and eviction replayable in
+tests.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from ..kernels.paged_kv import PagedKVCache
+from ..resilience.errors import PageExhaustedError
+
+
+def pages_needed(tokens: int, page_size: int) -> int:
+    """Pages covering ``tokens`` rows (at least one: a slot's first token
+    always needs a page)."""
+    return max(1, -(-tokens // page_size))
+
+
+class PagePool:
+    """Deterministic FIFO free-list over the cache's page ids."""
+
+    def __init__(self, num_pages: int) -> None:
+        self._num_pages = num_pages
+        self._free: deque[int] = deque(range(num_pages))
+
+    @property
+    def num_pages(self) -> int:
+        return self._num_pages
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self._num_pages - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """Pop ``n`` page ids; raises :class:`PageExhaustedError` when the
+        pool cannot cover them (callers decide whether to evict first)."""
+        if n > len(self._free):
+            raise PageExhaustedError(requested=n, free=len(self._free))
+        return [self._free.popleft() for _ in range(n)]
+
+    def release(self, page_ids: list[int]) -> None:
+        self._free.extend(page_ids)
+
+
+def release_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
+    """Reset a slot on the device cache: table row back to -1 sentinels,
+    length to 0 — so a reused slot can never read a predecessor's pages
+    (the decode kernel masks on length; gather clamps -1 to page 0 whose
+    rows the mask also kills)."""
+    return PagedKVCache(
+        cache.k_pages,
+        cache.v_pages,
+        cache.page_table.at[slot].set(-1),
+        cache.lengths.at[slot].set(0),
+    )

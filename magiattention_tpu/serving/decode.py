@@ -1,0 +1,136 @@
+"""Batched decode attention with the serving fallback ladder.
+
+Three rungs, descending (docs/serving.md):
+
+1. **Pallas paged-decode kernel** (:func:`~..kernels.paged_decode.paged_decode_attn`)
+   — one batched call, page-table prefetch, traced lengths (no retrace per
+   step). Armed with its own ``serve_decode`` injection site (NOT the FFA
+   ``kernel_lowering`` site, which prefill's FFA calls also arm — faulting
+   that would crash prefill, whose calls have no ladder around them).
+2. **gather+FFA reference** (:func:`~..kernels.paged_kv.paged_attn` per
+   active slot) — the pre-existing path; host-static lengths, so each new
+   length traces a fresh plan. This is the serve-smoke bitwise-equality
+   target (``MAGI_ATTENTION_SERVE_DECODE_KERNEL=0`` pins it).
+3. **dense jnp softmax** over the gathered pages — the sdpa_online-style
+   last resort with no Pallas in the loop.
+
+Descent follows the resilience contract of ``ffa.ffa_bwd_pallas_dispatch``:
+recoverable failure types from :func:`kernel_failure_types`, descent only
+under ``MAGI_ATTENTION_FALLBACK=1`` (otherwise failures propagate), one
+``resilience`` telemetry record per hop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..env import resilience as env_resilience
+from ..env import serve as env_serve
+from ..kernels.paged_decode import paged_decode_attn
+from ..kernels.paged_kv import PagedKVCache, gather_kv, paged_attn
+from ..resilience import fallback as _fallback
+from ..resilience.inject import maybe_inject
+
+NEG_INF = float("-inf")
+
+
+def decode_attn_step(
+    q_batch: jax.Array,
+    cache: PagedKVCache,
+    host_lengths: tuple[int, ...],
+    softmax_scale: float | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """One decode step for every active slot.
+
+    Args:
+        q_batch: ``(max_seqs, hq, d)`` — one query row per slot (zeros on
+            inactive slots).
+        cache: the paged cache AFTER this step's k/v rows were appended.
+        host_lengths: per-slot token counts as host ints (0 = inactive);
+            must match ``cache.lengths`` — the gather/dense rungs need them
+            static, the kernel rung ignores them.
+
+    Returns (out ``(max_seqs, hq, dv)``, lse ``(max_seqs, hq)``).
+    """
+    mode = env_serve.serve_decode_kernel()
+    failures = _fallback.kernel_failure_types()
+    if mode != "0":
+        try:
+            maybe_inject("serve_decode")
+            return paged_decode_attn(
+                q_batch, cache, softmax_scale=softmax_scale
+            )
+        except failures as e:
+            if not env_resilience.is_fallback_enable():
+                raise
+            _fallback.record_resilience_event(
+                "fallback", "serve_decode",
+                action_detail="paged_decode_to_gather_ffa",
+                error=type(e).__name__,
+            )
+    try:
+        return _gather_ffa_decode(q_batch, cache, host_lengths, softmax_scale)
+    except failures as e:
+        if not env_resilience.is_fallback_enable():
+            raise
+        _fallback.record_resilience_event(
+            "fallback", "serve_decode",
+            action_detail="gather_ffa_to_dense",
+            error=type(e).__name__,
+        )
+    return _dense_decode(q_batch, cache, host_lengths, softmax_scale)
+
+
+def _gather_ffa_decode(q_batch, cache, host_lengths, softmax_scale):
+    """Per-slot gather+FFA decode: the reference rung. The new token sits
+    at position ``length - 1`` (appended before attending), so the causal
+    band covers exactly the stored rows."""
+    S, hq, d = q_batch.shape
+    dv = cache.v_pages.shape[-1]
+    max_pages = cache.page_table.shape[1]
+    outs, lses = [], []
+    for s, length in enumerate(host_lengths):
+        if length <= 0:
+            outs.append(jnp.zeros((hq, dv), q_batch.dtype))
+            lses.append(jnp.full((hq,), NEG_INF, jnp.float32))
+            continue
+        out, lse = paged_attn(
+            q_batch[s : s + 1], cache, s,
+            q_start=int(length) - 1,
+            max_pages=max_pages,
+            softmax_scale=softmax_scale,
+        )
+        outs.append(out[0])
+        lses.append(lse[0])
+    return jnp.stack(outs), jnp.stack(lses)
+
+
+def _dense_decode(q_batch, cache, host_lengths, softmax_scale):
+    """Masked dense softmax over the gathered pages — no Pallas anywhere."""
+    S, hq, d = q_batch.shape
+    dv = cache.v_pages.shape[-1]
+    hk = cache.k_pages.shape[2]
+    g = hq // hk
+    if softmax_scale is None:
+        softmax_scale = float(d) ** -0.5
+    outs, lses = [], []
+    for s, length in enumerate(host_lengths):
+        if length <= 0:
+            outs.append(jnp.zeros((hq, dv), q_batch.dtype))
+            lses.append(jnp.full((hq,), NEG_INF, jnp.float32))
+            continue
+        k, v = gather_kv(cache, s)  # (cap, hk, d)
+        k = k[:length].astype(jnp.float32)
+        v = v[:length].astype(jnp.float32)
+        q = q_batch[s].astype(jnp.float32)  # (hq, d)
+        kh = jnp.repeat(k, g, axis=1)  # (length, hq, d)
+        scores = jnp.einsum("hd,lhd->hl", q, kh) * softmax_scale
+        m = jnp.max(scores, axis=1, keepdims=True)
+        p = jnp.exp(scores - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        vh = jnp.repeat(v, g, axis=1)
+        out = jnp.einsum("hl,lhd->hd", p / l, vh)
+        outs.append(out.astype(q_batch.dtype))
+        lses.append((m[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32))
+    return jnp.stack(outs), jnp.stack(lses)

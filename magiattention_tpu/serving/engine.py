@@ -1,0 +1,199 @@
+"""Continuous-batching serving engine.
+
+``ServeEngine.step()`` is one scheduler tick: admit + prefill newly
+admitted requests, then run ONE decode step for every occupied slot (the
+batch is a static ``(max_slots, hq, d)`` block — empty slots carry zero
+queries and length 0), then retire completed requests. Interleaving
+prefill and decode inside one tick is what "continuous batching" means
+here: a long prompt never stalls other requests for more than a tick.
+
+Every tick emits a ``serve_step`` telemetry record (docs/observability.md)
+when telemetry is enabled; wall-clock timing uses ``time.perf_counter``
+directly — serving/ is host orchestration, outside the kernels/functional
+no-host-clock lint boundary (MAGI-L002).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..env import serve as env_serve
+from ..kernels.paged_kv import PagedKVCache, append_kv
+from .cache import PagePool
+from .decode import decode_attn_step
+from .model import ToyModel
+from .prefill import prefill_request
+from .scheduler import Scheduler, ServeRequest
+
+__all__ = ["ServeConfig", "ServeEngine", "ServeRequest"]
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Static-shape envelope of one engine instance. Everything here fixes
+    an array shape or a traversal schedule, so two engines with equal
+    configs replay each other exactly."""
+
+    page_size: int = 16
+    num_pages: int = 64
+    max_slots: int = 4
+    max_pages_per_seq: int = 16
+    prefill_chunk: int = 64
+    softmax_scale: float | None = None
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        num_pages = env_serve.serve_num_pages()
+        return cls(
+            page_size=env_serve.serve_page_size(),
+            num_pages=num_pages,
+            max_slots=env_serve.serve_max_slots(),
+            max_pages_per_seq=num_pages,
+            prefill_chunk=env_serve.serve_prefill_chunk(),
+        )
+
+
+class ServeEngine:
+    """Drives a :class:`ToyModel`-shaped model over a shared paged cache."""
+
+    def __init__(self, model: ToyModel, config: ServeConfig) -> None:
+        self.model = model
+        self.config = config
+        self.cache = PagedKVCache.create(
+            num_pages=config.num_pages,
+            page_size=config.page_size,
+            n_kv_heads=model.n_kv_heads,
+            head_dim=model.head_dim,
+            max_seqs=config.max_slots,
+            max_pages_per_seq=config.max_pages_per_seq,
+            dtype=jnp.float32,
+        )
+        self.scheduler = Scheduler(
+            PagePool(config.num_pages), config.max_slots, config.page_size
+        )
+        self.step_count = 0
+        self.finished: list[ServeRequest] = []
+
+    # -- request intake ---------------------------------------------------
+    def submit(self, req: ServeRequest) -> None:
+        if req.prompt_len < 1:
+            raise ValueError(f"request {req.req_id}: empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(f"request {req.req_id}: max_new_tokens < 1")
+        req.submit_time = time.perf_counter()
+        self.scheduler.submit_request(req)
+
+    # -- one tick ---------------------------------------------------------
+    def step(self) -> dict:
+        """Admit, prefill, decode one token per active slot, retire.
+        Returns the tick's stats dict (mirrors the telemetry record)."""
+        t0 = time.perf_counter()
+        cfg = self.config
+        sched = self.scheduler
+        admitted = evicted = completed = 0
+        prefill_tokens = decode_tokens = 0
+
+        # 1. admission + prefill
+        self.cache, newly = sched.admit(self.cache)
+        for req in newly:
+            self.cache, last_hidden = prefill_request(
+                self.model, self.cache, req.slot, req.prompt,
+                cfg.prefill_chunk, cfg.softmax_scale,
+            )
+            req.length = req.prompt_len
+            req.pending_x = self.model.next_input(last_hidden)
+            prefill_tokens += req.prompt_len
+            admitted += 1
+
+        # 2. page growth for this tick's tokens (may evict — including a
+        # request admitted above, whose prefill is then discarded and
+        # deterministically redone after re-admission)
+        for slot in range(cfg.max_slots):
+            req = sched.slots[slot]
+            if req is None or req.pending_x is None:
+                continue
+            self.cache, n_evicted = sched.ensure_capacity(
+                self.cache, req, req.length + 1
+            )
+            evicted += n_evicted
+
+        # 3. decode one token per surviving slot
+        q_rows: dict[int, jnp.ndarray] = {}
+        for slot in range(cfg.max_slots):
+            req = sched.slots[slot]
+            if req is None or req.pending_x is None:
+                continue
+            q, k, v = self.model.qkv(req.pending_x[None])
+            self.cache = append_kv(self.cache, slot, k, v)
+            req.length += 1
+            q_rows[slot] = q[0]
+            decode_tokens += 1
+
+        if q_rows:
+            hq, d = self.model.n_heads, self.model.head_dim
+            zero_row = jnp.zeros((hq, d), jnp.float32)
+            q_batch = jnp.stack(
+                [q_rows.get(s, zero_row) for s in range(cfg.max_slots)]
+            )
+            host_lengths = tuple(
+                sched.slots[s].length if s in q_rows else 0
+                for s in range(cfg.max_slots)
+            )
+            out, _ = decode_attn_step(
+                q_batch, self.cache, host_lengths, cfg.softmax_scale
+            )
+            for slot in sorted(q_rows):
+                req = sched.slots[slot]
+                hidden = self.model.project(out[slot : slot + 1])[0]
+                req.generated.append(np.asarray(hidden))
+                if req.first_token_time is None:
+                    req.first_token_time = time.perf_counter()
+                req.pending_x = self.model.next_input(hidden)
+
+        # 4. retirement
+        for slot in range(cfg.max_slots):
+            req = sched.slots[slot]
+            if req is not None and req.done:
+                req.finish_time = time.perf_counter()
+                self.cache = sched.finish(self.cache, req)
+                self.finished.append(req)
+                completed += 1
+
+        self.step_count += 1
+        stats = dict(
+            step=self.step_count,
+            wall_ms=(time.perf_counter() - t0) * 1e3,
+            occupancy=len(sched.active) / cfg.max_slots,
+            pages_in_use=sched.pool.used_count,
+            waiting=len(sched.waiting),
+            admitted=admitted,
+            evicted=evicted,
+            completed=completed,
+            prefill_tokens=prefill_tokens,
+            decode_tokens=decode_tokens,
+        )
+        if telemetry.enabled():
+            telemetry.record_event("serve_step", **stats)
+            telemetry.inc("serve.steps")
+        return stats
+
+    # -- full drain -------------------------------------------------------
+    def run(
+        self, requests: list[ServeRequest], max_steps: int = 100_000
+    ) -> list[ServeRequest]:
+        """Submit ``requests`` and tick until every one completes."""
+        for req in requests:
+            self.submit(req)
+        while self.scheduler.has_work():
+            self.step()
+            if self.step_count > max_steps:
+                raise RuntimeError(
+                    f"serving loop exceeded {max_steps} steps "
+                    f"({len(self.finished)}/{len(requests)} done)"
+                )
+        return self.finished

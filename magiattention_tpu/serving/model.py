@@ -1,0 +1,89 @@
+"""Deterministic single-attention-layer toy model for the serving loop.
+
+The engine is model-agnostic: it needs exactly three maps — hidden rows to
+q/k/v heads, attention output back to a hidden row, and a hidden row to the
+next step's input. This module provides the smallest deterministic model
+with that interface, used by the serve-smoke loop, the scheduler tests and
+``benchmarks/serve_bench.py``. Float32 throughout so the serve-smoke
+bitwise-equality criterion is about the serving machinery, not dtype
+rounding; k/v for a token depend only on that token's input, which is what
+makes chunked prefill and continuous batching exactly replayable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ToyModel:
+    """One attention layer's projections: x -> (q, k, v) -> out -> x'."""
+
+    wq: jax.Array  # (d_model, n_heads * head_dim)
+    wk: jax.Array  # (d_model, n_kv_heads * head_dim)
+    wv: jax.Array  # (d_model, n_kv_heads * head_dim)
+    wo: jax.Array  # (n_heads * head_dim, d_model)
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+
+    @property
+    def d_model(self) -> int:
+        return self.wq.shape[0]
+
+    @classmethod
+    def create(
+        cls,
+        d_model: int = 32,
+        n_heads: int = 4,
+        n_kv_heads: int = 2,
+        head_dim: int = 16,
+        seed: int = 0,
+    ) -> "ToyModel":
+        rng = np.random.default_rng(seed)
+        scale = d_model ** -0.5
+
+        def w(rows: int, cols: int) -> jax.Array:
+            return jnp.asarray(
+                (rng.standard_normal((rows, cols)) * scale).astype(np.float32)
+            )
+
+        return cls(
+            wq=w(d_model, n_heads * head_dim),
+            wk=w(d_model, n_kv_heads * head_dim),
+            wv=w(d_model, n_kv_heads * head_dim),
+            wo=w(n_heads * head_dim, d_model),
+            n_heads=n_heads,
+            n_kv_heads=n_kv_heads,
+            head_dim=head_dim,
+        )
+
+    def qkv(self, x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """``(t, d_model)`` hidden rows -> q ``(t, hq, d)``, k/v ``(t, hk, d)``."""
+        t = x.shape[0]
+        q = (x @ self.wq).reshape(t, self.n_heads, self.head_dim)
+        k = (x @ self.wk).reshape(t, self.n_kv_heads, self.head_dim)
+        v = (x @ self.wv).reshape(t, self.n_kv_heads, self.head_dim)
+        return q, k, v
+
+    def project(self, attn_out: jax.Array) -> jax.Array:
+        """Attention output ``(t, hq, dv)`` -> hidden rows ``(t, d_model)``."""
+        t = attn_out.shape[0]
+        return attn_out.reshape(t, -1) @ self.wo
+
+    def next_input(self, hidden: jax.Array) -> jax.Array:
+        """The autoregressive closure: a generated hidden row becomes the
+        next step's input row (tanh keeps magnitudes bounded so long
+        generations stay finite)."""
+        return jnp.tanh(hidden)
+
+    def prompt(self, length: int, seed: int) -> jax.Array:
+        """A deterministic synthetic prompt ``(length, d_model)``."""
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(
+            rng.standard_normal((length, self.d_model)).astype(np.float32)
+        )

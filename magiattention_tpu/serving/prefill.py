@@ -1,0 +1,59 @@
+"""Chunked prefill over the paged cache (serving layer).
+
+Reuses the existing FFA forward via :func:`~..kernels.paged_kv.paged_attn`:
+each chunk's k/v are appended to the request's pages functionally, then the
+chunk's queries attend causally over everything stored so far. The chunk
+schedule is a pure function of (prompt length, chunk size) and this module
+is shared by the engine AND the sequential reference replay — schedule
+identity is what makes the serve-smoke bitwise-equality criterion hold by
+construction (per-row FFA online softmax is invariant to the extra masked
+rows of a shared pool's garbage pages).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..kernels.paged_kv import PagedKVCache, append_kv, paged_attn
+from .model import ToyModel
+
+
+def prefill_schedule(total: int, chunk: int) -> list[tuple[int, int]]:
+    """(start, size) chunks covering ``[0, total)`` in ``chunk`` steps."""
+    if total <= 0:
+        return []
+    chunk = max(1, chunk)
+    return [
+        (start, min(chunk, total - start))
+        for start in range(0, total, chunk)
+    ]
+
+
+def prefill_request(
+    model: ToyModel,
+    cache: PagedKVCache,
+    slot: int,
+    prompt: jax.Array,
+    chunk: int,
+    softmax_scale: float | None = None,
+) -> tuple[PagedKVCache, jax.Array]:
+    """Prefill one request's prompt into its slot, chunk by chunk.
+
+    Pages must be pre-assigned (scheduler admission). Returns the updated
+    cache and the LAST prompt position's hidden row ``(d_model,)`` — the
+    seed of the first generated token.
+    """
+    last_out = None
+    for start, size in prefill_schedule(int(prompt.shape[0]), chunk):
+        x = prompt[start : start + size]
+        q, k, v = model.qkv(x)
+        cache = append_kv(cache, slot, k, v)
+        out, _ = paged_attn(
+            q, cache, slot,
+            q_start=start,
+            max_pages=cache.page_table.shape[1],
+            softmax_scale=softmax_scale,
+        )
+        last_out = out[-1:]  # (1, hq, dv)
+    assert last_out is not None, "empty prompt"
+    return cache, model.project(last_out)[0]

@@ -1,0 +1,74 @@
+"""Sequential per-request replay — the engine's equality oracle.
+
+Each request runs alone against a fresh single-sequence cache with every
+page pre-assigned, using the SAME :func:`~.prefill.prefill_request` chunk
+schedule and the SAME gather+FFA decode call the engine's reference rung
+makes. Per-row FFA results depend only on the unmasked rows (masked scores
+are the MASK_VALUE constant regardless of what garbage the gathered pages
+hold, and their exp2 contributions underflow to exactly 0.0), so with an
+identical chunk schedule, ``max_pages`` and env snapshot, the engine under
+``MAGI_ATTENTION_SERVE_DECODE_KERNEL=0`` must reproduce this replay
+BITWISE — the serve-smoke acceptance gate.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels.paged_kv import PagedKVCache, append_kv, assign_pages, paged_attn
+from .engine import ServeConfig
+from .model import ToyModel
+from .prefill import prefill_request
+from .scheduler import ServeRequest
+
+
+def generate_reference(
+    model: ToyModel, req: ServeRequest, config: ServeConfig
+) -> list[np.ndarray]:
+    """Generate ``req``'s tokens in isolation; returns the per-step hidden
+    rows (same objects the engine stores in ``req.generated``)."""
+    P = config.max_pages_per_seq
+    cache = PagedKVCache.create(
+        num_pages=P,
+        page_size=config.page_size,
+        n_kv_heads=model.n_kv_heads,
+        head_dim=model.head_dim,
+        max_seqs=1,
+        max_pages_per_seq=P,
+        dtype=jnp.float32,
+    )
+    cache = assign_pages(cache, 0, np.arange(P, dtype=np.int32))
+
+    cache, last_hidden = prefill_request(
+        model, cache, 0, req.prompt, config.prefill_chunk,
+        config.softmax_scale,
+    )
+    length = req.prompt_len
+    x = model.next_input(last_hidden)
+
+    outs: list[np.ndarray] = []
+    for _ in range(req.max_new_tokens):
+        q, k, v = model.qkv(x[None])
+        cache = append_kv(cache, 0, k, v)
+        length += 1
+        out, _ = paged_attn(
+            q, cache, 0,
+            q_start=length - 1,
+            max_pages=P,
+            softmax_scale=config.softmax_scale,
+        )
+        hidden = model.project(out)[0]
+        outs.append(np.asarray(hidden))
+        x = model.next_input(hidden)
+    return outs
+
+
+def run_reference(
+    model: ToyModel, requests: list[ServeRequest], config: ServeConfig
+) -> dict[int, list[np.ndarray]]:
+    """Replay every request sequentially; keyed by ``req_id``."""
+    return {
+        req.req_id: generate_reference(model, req, config)
+        for req in requests
+    }

@@ -88,9 +88,10 @@ def ffa_kernel_residency(
     unpacked kernels are per-q-head, so ``group`` is ignored for them
     except dkv's lse/delta sublane layout which is group-independent.
     """
-    if kind not in ("fwd", "dq", "dkv", "fused", "delta"):
+    if kind not in ("fwd", "dq", "dkv", "fused", "delta", "decode"):
         raise ValueError(
-            f"kind must be 'fwd'|'dq'|'dkv'|'fused'|'delta', got {kind!r}"
+            f"kind must be 'fwd'|'dq'|'dkv'|'fused'|'delta'|'decode', "
+            f"got {kind!r}"
         )
     dv = head_dim_v or head_dim
     g = group if packed else 1
@@ -134,13 +135,22 @@ def ffa_kernel_residency(
         blocks += 2 * g * bq * d * f32  # dq out + aliased dqz in (fp32)
         scratch = (bk * d + bk * dv) * f32
         inter = 2 * g * bq * bk * f32  # s_t + dp_t
-    else:  # delta
+    elif kind == "delta":
         # stateless rowsum(dO ⊙ O) map kernel: o + do blocks in, one
         # lanes-broadcast fp32 block out, no scratch; group-independent
         blocks = 2 * bq * dv * dtype_bytes  # o + do
         blocks += bq * 128 * f32  # delta (lanes-broadcast)
         scratch = 0
         inter = bq * dv * f32  # fp32 elementwise product
+    else:  # decode (kernels/paged_decode.py): bq = GQA group rows of one
+        # kv head, bk = page_size; same fwd residency shape minus GQA
+        # packing (group/packed/emit_ml are ignored)
+        blocks = bq * d * dtype_bytes  # q group tile
+        blocks += bk * d * dtype_bytes + bk * dv * dtype_bytes  # one k/v page
+        blocks += bq * dv * dtype_bytes  # out
+        blocks += bq * 128 * f32  # lse (lanes-broadcast)
+        scratch = (2 * bq * 128 + bq * dv) * f32  # m, l, acc
+        inter = bq * bk * f32  # s (p reuses its storage)
     total = 2 * blocks + scratch
     if include_intermediates:
         total += inter

@@ -7,11 +7,12 @@ report and exits non-zero on ANY violation (no waiver mechanism exists for
 K rules by design). This is the third leg of ``make analysis`` next to the
 AST linter and the plan verifier.
 
-``--selftest`` runs the seeded-mutation harness instead: six planted
+``--selftest`` runs the seeded-mutation harness instead: eight planted
 defects (oversized scratch, swapped index_map axes, missing accumulator
-init, bf16 accumulator, unlisted env key, corrupted live-extent row) must
-each fire EXACTLY their expected K rule, proving the checker itself
-detects what it claims to.
+init, deleted revisit init, bf16 accumulator, unlisted env key, corrupted
+live-extent row, out-of-range decode page-table id) must each fire
+EXACTLY their expected K rule, proving the checker itself detects what it
+claims to.
 """
 
 from __future__ import annotations

@@ -246,6 +246,34 @@ def aggregate(records: list[dict]) -> dict:
             "hops_by_site": dict(sorted(hops_by_site.items())),
         }
 
+    serves = kinds.get("serve_step", [])
+    if serves:
+        walls = [s["wall_ms"] for s in serves if s.get("wall_ms") is not None]
+        occ = [
+            s["occupancy"] for s in serves if s.get("occupancy") is not None
+        ]
+        pages = [
+            s["pages_in_use"] for s in serves
+            if s.get("pages_in_use") is not None
+        ]
+        agg["serve"] = {
+            "steps": len(serves),
+            "admitted_total": sum(s.get("admitted", 0) for s in serves),
+            "evicted_total": sum(s.get("evicted", 0) for s in serves),
+            "completed_total": sum(s.get("completed", 0) for s in serves),
+            "prefill_tokens_total": sum(
+                s.get("prefill_tokens", 0) for s in serves
+            ),
+            "decode_tokens_total": sum(
+                s.get("decode_tokens", 0) for s in serves
+            ),
+            "occupancy_mean": sum(occ) / len(occ) if occ else None,
+            "pages_in_use_last": pages[-1] if pages else None,
+            "pages_in_use_max": max(pages) if pages else None,
+            "wall_ms_mean": sum(walls) / len(walls) if walls else None,
+            "wall_ms_max": max(walls) if walls else None,
+        }
+
     hier = kinds.get("hier_plan", [])
     if hier:
         last = hier[-1]
@@ -445,6 +473,30 @@ def format_summary(agg: dict) -> str:
         )
         for site, n in rs["hops_by_site"].items():
             lines.append(f"  hops at {site}: {n}")
+
+    sv = agg.get("serve")
+    if sv:
+        lines.append("")
+        lines.append(
+            f"serving steps={sv['steps']} admitted={sv['admitted_total']} "
+            f"evicted={sv['evicted_total']} "
+            f"completed={sv['completed_total']}"
+        )
+        lines.append(
+            f"  tokens: prefill={sv['prefill_tokens_total']} "
+            f"decode={sv['decode_tokens_total']}"
+        )
+        if sv.get("occupancy_mean") is not None:
+            lines.append(
+                f"  occupancy mean={sv['occupancy_mean']:.2f}; "
+                f"pages_in_use last={sv['pages_in_use_last']} "
+                f"max={sv['pages_in_use_max']}"
+            )
+        if sv.get("wall_ms_mean") is not None:
+            lines.append(
+                f"  wall per step: mean={sv['wall_ms_mean']:.1f} ms "
+                f"max={sv['wall_ms_max']:.1f} ms"
+            )
 
     hc = agg.get("hier_comm")
     if hc:

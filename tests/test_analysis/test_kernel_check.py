@@ -15,18 +15,20 @@ from magiattention_tpu.analysis.kernel_check import (
     _TOY_FUSED_CONTRACTS,
     _TOY_FUSED_KERNEL_SRC,
     _TOY_KERNEL_SRC,
+    _pallas_contracts,
     K5_ALLOWLIST,
+    capture_decode_contracts,
     capture_ffa_contracts,
     check_contract,
     check_env_keys,
     check_kernel_sources,
+    decode_corpus,
     discover_pallas_sites,
     golden_corpus,
     run_kernel_audit,
     run_seeded_mutations,
 )
 from magiattention_tpu.analysis.violation import VerifyReport
-from magiattention_tpu.kernels.ffa import PALLAS_CONTRACTS
 
 
 # -- discovery + annotation completeness ------------------------------------
@@ -34,10 +36,12 @@ from magiattention_tpu.kernels.ffa import PALLAS_CONTRACTS
 
 def test_discovery_finds_every_pallas_site():
     sites = discover_pallas_sites()
-    assert len(sites) == 9
+    assert len(sites) == 10
     names = {s.kernel_name for s in sites}
-    assert names == set(PALLAS_CONTRACTS)
-    assert all(s.relpath == "kernels/ffa.py" for s in sites)
+    assert names == set(_pallas_contracts())
+    assert {s.relpath for s in sites} == {
+        "kernels/ffa.py", "kernels/paged_decode.py"
+    }
 
 
 # -- source-level rules on the real kernels ---------------------------------
@@ -102,12 +106,12 @@ def test_k5_allowlist_entries_carry_a_proof():
 
 def test_seeded_mutations_fire_exactly_their_rule():
     results = run_seeded_mutations()
-    assert len(results) == 7
+    assert len(results) == 8
     assert {r["expected_rule"] for r in results} == {
         "K1", "K2", "K3", "K4", "K5"
     }
     assert {r["mutation"] for r in results} >= {
-        "corrupted_extent_row", "deleted_revisit_init"
+        "corrupted_extent_row", "deleted_revisit_init", "oob_page_table"
     }
     for r in results:
         assert r["ok"], (
@@ -141,13 +145,26 @@ def test_smoke_audit_covers_all_kernels_and_reports_vmem(smoke_audit):
     # pass), which is exactly why it is the smoke slice
     report, rows = smoke_audit
     config_rows = [r for r in rows if r["config"] != "reachable_space_sweep"]
-    assert {r["kernel"] for r in config_rows} == set(PALLAS_CONTRACTS)
+    assert {r["kernel"] for r in config_rows} == set(_pallas_contracts())
     for r in config_rows:
         assert 0 < r["vmem_bytes"] <= r["vmem_total_bytes"]
         assert r["vmem_total_bytes"] <= r["vmem_allowed_bytes"]
     sweep = [r for r in rows if r["config"] == "reachable_space_sweep"]
     assert len(sweep) == 1 and sweep[0]["configs_checked"] > 0
     assert sweep[0]["worst_bytes"] <= sweep[0]["allowed_bytes"]
+
+
+def test_decode_corpus_contracts_are_clean():
+    # the paged-decode kernel joins the audit corpus: every config must
+    # capture exactly one contract and pass K1/K3/K4 on it
+    for dspec in decode_corpus():
+        contracts = capture_decode_contracts(dspec)
+        assert [c.kernel_name for c in contracts] == ["_paged_decode_kernel"]
+        report = VerifyReport()
+        check_contract(report, contracts[0], dspec.name)
+        assert report.fired_rules() == set(), "\n".join(
+            str(v) for v in report.violations
+        )
 
 
 def test_check_contract_is_deterministic(smoke_audit):

@@ -67,6 +67,165 @@ def test_paged_decode_matches_dense():
     assert_close(lse, rlse, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
 
 
+def test_append_exactly_at_page_boundary():
+    """An append whose last row lands exactly on a page boundary must fill
+    the page completely and leave the NEXT page untouched until the next
+    append writes row 0 of it."""
+    rng = np.random.default_rng(7)
+    cache = PagedKVCache.create(
+        num_pages=8, page_size=PS, n_kv_heads=HK, head_dim=D,
+        max_seqs=1, max_pages_per_seq=4, dtype=jnp.float32,
+    )
+    cache = assign_pages(cache, 0, np.asarray([3, 1, 6]))
+    k_nat = jnp.asarray(rng.standard_normal((2 * PS + 1, HK, D)), jnp.float32)
+    v_nat = jnp.asarray(rng.standard_normal((2 * PS + 1, HK, D)), jnp.float32)
+
+    # fill pages 0 and 1 to EXACTLY their boundary in two appends
+    cache = append_kv(cache, 0, k_nat[:PS], v_nat[:PS])
+    assert int(cache.lengths[0]) == PS
+    cache = append_kv(cache, 0, k_nat[PS : 2 * PS], v_nat[PS : 2 * PS])
+    assert int(cache.lengths[0]) == 2 * PS
+    np.testing.assert_array_equal(
+        np.asarray(cache.k_pages[1]), np.asarray(k_nat[PS : 2 * PS])
+    )
+    assert not np.any(np.asarray(cache.k_pages[6]))  # third page untouched
+
+    # the next single-row append starts the third page at row 0
+    cache = append_kv(cache, 0, k_nat[2 * PS :], v_nat[2 * PS :])
+    np.testing.assert_array_equal(
+        np.asarray(cache.k_pages[6, 0]), np.asarray(k_nat[2 * PS])
+    )
+    k, _ = gather_kv(cache, 0, max_pages=3)
+    np.testing.assert_array_equal(np.asarray(k[: 2 * PS + 1]), np.asarray(k_nat))
+
+
+def test_unallocated_rows_never_contribute():
+    """-1 table entries clamp to page 0 on gather; poisoning every
+    unallocated page (including page 0) with huge garbage must not change
+    paged_attn's output — the length mask kills those rows exactly."""
+    rng = np.random.default_rng(8)
+    T = PS + 5
+    k_nat = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    v_nat = jnp.asarray(rng.standard_normal((T, HK, D)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+
+    cache = build_cache(k_nat, v_nat, [5, 2])
+    out_clean, lse_clean = paged_attn(q, cache, 0, q_start=T - 1, max_pages=8)
+
+    # poison everything the sequence does NOT own (pages 5 and 2 are its)
+    poison = jnp.full_like(cache.k_pages, 1e9)
+    owned = np.zeros(32, bool)
+    owned[[5, 2]] = True
+    keep = jnp.asarray(owned)[:, None, None, None]
+    cache_p = PagedKVCache(
+        jnp.where(keep, cache.k_pages, poison),
+        jnp.where(keep, cache.v_pages, poison),
+        cache.page_table, cache.lengths,
+    )
+    out_p, lse_p = paged_attn(q, cache_p, 0, q_start=T - 1, max_pages=8)
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_clean))
+    np.testing.assert_array_equal(np.asarray(lse_p), np.asarray(lse_clean))
+
+
+def test_per_sequence_length_masking_parity():
+    """Two sequences with different lengths in one cache: each slot's
+    decode must match the dense reference over exactly its own rows."""
+    rng = np.random.default_rng(9)
+    lens = [PS + 3, 2 * PS]  # ragged, one exactly at a page boundary
+    cache = PagedKVCache.create(
+        num_pages=16, page_size=PS, n_kv_heads=HK, head_dim=D,
+        max_seqs=2, max_pages_per_seq=4, dtype=jnp.float32,
+    )
+    nat = {}
+    for s, (length, pages) in enumerate(zip(lens, [[9, 4], [1, 13]])):
+        cache = assign_pages(cache, s, np.asarray(pages))
+        k_nat = jnp.asarray(rng.standard_normal((length, HK, D)), jnp.float32)
+        v_nat = jnp.asarray(rng.standard_normal((length, HK, D)), jnp.float32)
+        cache = append_kv(cache, s, k_nat, v_nat)
+        nat[s] = (k_nat, v_nat)
+
+    for s, length in enumerate(lens):
+        q = jnp.asarray(rng.standard_normal((1, HQ, D)), jnp.float32)
+        out, lse = paged_attn(q, cache, s, q_start=length - 1, max_pages=4)
+        mask = np.ones((1, length), dtype=bool)
+        ro, rlse = ref_attn(q, *nat[s], mask, compute_dtype=jnp.float32)
+        assert_close(out, ro, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
+        assert_close(lse, rlse, atol=1e-4, rtol=1e-4, norm_rtol=1e-4)
+
+
+def test_cache_update_under_jit():
+    """append_kv is functional and must trace: a jitted step that appends
+    one token and returns the cache matches the eager update."""
+    rng = np.random.default_rng(10)
+    T = PS - 1
+    k_nat = jnp.asarray(rng.standard_normal((T + 2, HK, D)), jnp.float32)
+    v_nat = jnp.asarray(rng.standard_normal((T + 2, HK, D)), jnp.float32)
+
+    def fresh():
+        cache = PagedKVCache.create(
+            num_pages=8, page_size=PS, n_kv_heads=HK, head_dim=D,
+            max_seqs=1, max_pages_per_seq=4, dtype=jnp.float32,
+        )
+        cache = assign_pages(cache, 0, np.asarray([2, 6]))
+        return append_kv(cache, 0, k_nat[:T], v_nat[:T])
+
+    @jax.jit
+    def step(cache, k_new, v_new):
+        return append_kv(cache, 0, k_new, v_new)
+
+    jitted = fresh()
+    eager = fresh()
+    # two jitted appends: the second crosses the page boundary
+    for i in range(T, T + 2):
+        jitted = step(jitted, k_nat[i : i + 1], v_nat[i : i + 1])
+        eager = append_kv(eager, 0, k_nat[i : i + 1], v_nat[i : i + 1])
+    assert int(jitted.lengths[0]) == T + 2
+    for got, want in [
+        (jitted.k_pages, eager.k_pages), (jitted.v_pages, eager.v_pages),
+        (jitted.lengths, eager.lengths),
+    ]:
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_paged_decode_kernel_matches_gather_path():
+    """The Pallas decode kernel (interpret) vs the gather+FFA path on a
+    ragged batch including an empty slot and a page-boundary length."""
+    from magiattention_tpu.kernels.paged_decode import paged_decode_attn
+
+    rng = np.random.default_rng(11)
+    lens = [5, 0, 2 * PS, PS + 9]
+    cache = PagedKVCache.create(
+        num_pages=16, page_size=PS, n_kv_heads=HK, head_dim=D,
+        max_seqs=4, max_pages_per_seq=4, dtype=jnp.float32,
+    )
+    free = list(rng.permutation(16))
+    for s, length in enumerate(lens):
+        if length == 0:
+            continue
+        n = -(-length // PS)
+        pages, free = free[:n], free[n:]
+        cache = assign_pages(cache, s, np.asarray(pages))
+        k_nat = jnp.asarray(rng.standard_normal((length, HK, D)), jnp.float32)
+        v_nat = jnp.asarray(rng.standard_normal((length, HK, D)), jnp.float32)
+        cache = append_kv(cache, s, k_nat, v_nat)
+
+    q = jnp.asarray(rng.standard_normal((4, HQ, D)), jnp.float32)
+    out, lse = paged_decode_attn(q, cache, interpret=True)
+
+    for s, length in enumerate(lens):
+        if length == 0:
+            assert not np.any(np.asarray(out[s]))
+            assert np.all(np.asarray(lse[s]) == -np.inf)
+            continue
+        ro, rlse = paged_attn(
+            q[s : s + 1], cache, s, q_start=length - 1, max_pages=4
+        )
+        assert_close(out[s : s + 1], ro, atol=2e-5, rtol=2e-5,
+                     norm_rtol=2e-5)
+        assert_close(lse[s : s + 1], rlse, atol=2e-5, rtol=2e-5,
+                     norm_rtol=2e-5)
+
+
 @pytest.mark.slow
 def test_paged_prefill_chunk_matches_dense():
     rng = np.random.default_rng(2)

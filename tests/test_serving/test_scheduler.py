@@ -1,0 +1,165 @@
+"""Scheduler policy invariants (serving/scheduler.py): FIFO admission with
+head-of-line blocking under the page budget, LIFO eviction with restart
+semantics, typed PageExhaustedError when nothing is evictable, and
+deterministic slot reuse."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from magiattention_tpu.kernels.paged_kv import PagedKVCache
+from magiattention_tpu.resilience.errors import PageExhaustedError
+from magiattention_tpu.serving import PagePool, Scheduler, ServeRequest
+from magiattention_tpu.serving.cache import pages_needed
+
+PS = 4  # tokens per page
+
+
+def make_cache(num_pages=8, max_seqs=2, max_pages_per_seq=4):
+    return PagedKVCache.create(
+        num_pages=num_pages, page_size=PS, n_kv_heads=1, head_dim=8,
+        max_seqs=max_seqs, max_pages_per_seq=max_pages_per_seq,
+        dtype=jnp.float32,
+    )
+
+
+def make_req(req_id, prompt_len, max_new_tokens=2):
+    return ServeRequest(
+        req_id=req_id,
+        prompt=jnp.zeros((prompt_len, 4), jnp.float32),
+        max_new_tokens=max_new_tokens,
+    )
+
+
+def make_sched(num_pages=8, max_slots=2):
+    return Scheduler(PagePool(num_pages), max_slots, PS)
+
+
+def test_pages_needed():
+    assert pages_needed(1, PS) == 1
+    assert pages_needed(PS, PS) == 1
+    assert pages_needed(PS + 1, PS) == 2
+    assert pages_needed(0, PS) == 1  # a slot always holds one page
+
+
+class TestAdmission:
+    def test_fifo_order_and_slot_assignment(self):
+        sched = make_sched()
+        cache = make_cache()
+        for i in range(3):
+            sched.submit_request(make_req(i, prompt_len=PS))
+        cache, admitted = sched.admit(cache)
+        # two slots -> first two requests, in order, slots 0 and 1
+        assert [r.req_id for r in admitted] == [0, 1]
+        assert [r.slot for r in admitted] == [0, 1]
+        assert [r.admit_seq for r in admitted] == [0, 1]
+        assert [r.req_id for r in sched.waiting] == [2]
+        # their table rows hold the allocated pages
+        for r in admitted:
+            row = np.asarray(cache.page_table[r.slot])
+            assert list(row[: len(r.page_ids)]) == r.page_ids
+
+    def test_blocks_under_page_exhaustion(self):
+        """A head-of-line request whose prompt outsizes the free pool
+        blocks admission entirely — later requests may NOT jump it."""
+        sched = make_sched(num_pages=3)
+        cache = make_cache(num_pages=3)
+        sched.submit_request(make_req(0, prompt_len=3 * PS))  # needs 3
+        cache, admitted = sched.admit(cache)
+        assert [r.req_id for r in admitted] == [0]
+        sched.submit_request(make_req(1, prompt_len=2 * PS))  # 0 free
+        sched.submit_request(make_req(2, prompt_len=1))  # would fit a page
+        cache, admitted = sched.admit(cache)
+        assert admitted == []  # head-of-line blocked, no queue jumping
+        assert [r.req_id for r in sched.waiting] == [1, 2]
+        # freeing the first request unblocks FIFO admission
+        cache = sched.finish(cache, sched.slots[0])
+        cache, admitted = sched.admit(cache)
+        assert [r.req_id for r in admitted] == [1, 2]
+
+    def test_prompt_larger_than_table_row_rejected(self):
+        sched = make_sched(num_pages=8)
+        cache = make_cache(num_pages=8, max_pages_per_seq=2)
+        sched.submit_request(make_req(0, prompt_len=3 * PS))
+        with pytest.raises(ValueError, match="table width"):
+            sched.admit(cache)
+
+
+class TestEviction:
+    def _admitted_pair(self, num_pages=4):
+        sched = make_sched(num_pages=num_pages)
+        cache = make_cache(num_pages=num_pages)
+        sched.submit_request(make_req(0, prompt_len=2 * PS))
+        sched.submit_request(make_req(1, prompt_len=2 * PS))
+        cache, admitted = sched.admit(cache)
+        assert len(admitted) == 2
+        return sched, cache, admitted
+
+    def test_evicts_most_recently_admitted_other(self):
+        sched, cache, (r0, r1) = self._admitted_pair()
+        r0.length = r1.length = 2 * PS
+        # r0 grows past its pages with the pool dry -> r1 (newer) evicted
+        cache, evicted = sched.ensure_capacity(cache, r0, 2 * PS + 1)
+        assert evicted == 1
+        assert sched.slots[r0.slot] is r0 and r1.slot is None
+        assert r1.evictions == 1 and r1.page_ids == [] and r1.length == 0
+        assert list(sched.waiting) == [r1]  # re-queued at the FRONT
+        assert len(r0.page_ids) == 3
+        # the victim's table row is reset to sentinels
+        assert np.all(np.asarray(cache.page_table[1]) == -1)
+        assert int(cache.lengths[1]) == 0
+
+    def test_never_evicts_the_requester(self):
+        sched = make_sched(num_pages=2, max_slots=2)
+        cache = make_cache(num_pages=2)
+        sched.submit_request(make_req(0, prompt_len=2 * PS))
+        cache, (r0,) = sched.admit(cache)
+        r0.length = 2 * PS
+        with pytest.raises(PageExhaustedError) as ei:
+            sched.ensure_capacity(cache, r0, 2 * PS + 1)
+        assert ei.value.requested == 1 and ei.value.free == 0
+
+    def test_eviction_frees_pages_for_the_requester(self):
+        sched, cache, (r0, r1) = self._admitted_pair()
+        r0.length = r1.length = 2 * PS
+        victim_pages = list(r1.page_ids)
+        cache, _ = sched.ensure_capacity(cache, r0, 2 * PS + 1)
+        # the grown page came from the victim's freed set
+        assert r0.page_ids[-1] in victim_pages
+        assert sched.pool.used_count == len(r0.page_ids)
+
+
+class TestSlotReuse:
+    def test_finish_releases_everything(self):
+        sched = make_sched(num_pages=4)
+        cache = make_cache(num_pages=4)
+        sched.submit_request(make_req(0, prompt_len=2 * PS))
+        cache, (r0,) = sched.admit(cache)
+        assert sched.pool.free_count == 2
+        cache = sched.finish(cache, r0)
+        assert sched.pool.free_count == 4
+        assert sched.slots == [None, None]
+        assert np.all(np.asarray(cache.page_table[0]) == -1)
+
+    def test_reuse_is_deterministic(self):
+        """Two identical submit/finish interleavings allocate identical
+        pages and slots (FIFO free list, first-free slot)."""
+
+        def run():
+            sched = make_sched(num_pages=6)
+            cache = make_cache(num_pages=6)
+            trace = []
+            for i in range(4):
+                sched.submit_request(make_req(i, prompt_len=PS + 1))
+                cache, admitted = sched.admit(cache)
+                for r in admitted:
+                    trace.append((r.req_id, r.slot, tuple(r.page_ids)))
+                if i % 2 == 1:  # finish the oldest active
+                    oldest = min(
+                        sched.active, key=lambda r: r.admit_seq
+                    )
+                    cache = sched.finish(cache, oldest)
+                    trace.append(("finish", oldest.req_id))
+            return trace
+
+        assert run() == run()
