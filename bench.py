@@ -717,6 +717,130 @@ def run_bwd_suite() -> int:
 
 
 # ---------------------------------------------------------------------------
+# --dcn-suite: flat vs two-level (DCN x ICI) comm-plan A/B (CPU-safe)
+# ---------------------------------------------------------------------------
+
+
+def run_dcn_suite() -> int:
+    """Host-side A/B of flat vs two-level comm plans per mask and mesh.
+
+    Entirely plan-level (no device collectives), so the suite runs
+    identically on CPU and TPU hosts: for each (mask, n_outer x n_inner)
+    it solves both ways and reports the flat cross-node row volume, the
+    two-level post-dedup DCN rows (must never exceed the flat prediction),
+    the dedup ratio, and the modeled makespans under the flat
+    (pipeline_makespan) vs two-tier (two_level_makespan) cost models.
+    Rows append to benchmarks/history/bench_dcn.csv."""
+    import jax
+
+    from magiattention_tpu.common.enum import AttnMaskType
+    from magiattention_tpu.common.ranges import AttnRanges
+    from magiattention_tpu.config import DistAttnConfig, OverlapConfig
+    from magiattention_tpu.meta import (
+        make_attn_meta_from_dispatch_meta,
+        make_dispatch_meta_from_qk_ranges,
+    )
+    from magiattention_tpu.meta.solver.overlap_solver import (
+        OverlapStageCost,
+        pipeline_makespan,
+        two_level_makespan,
+    )
+
+    seq, chunk = 4096, 256
+    M = AttnMaskType
+    h = seq // 2
+    families = {
+        "causal": ([[0, seq]], [[0, seq]], [M.CAUSAL]),
+        "shared_prefix": (
+            [[0, seq], [512, seq]], [[0, 512], [512, seq]],
+            [M.FULL, M.CAUSAL],
+        ),
+        "varlen_block_causal": (
+            [[0, h], [h, seq]], [[0, h], [h, seq]], [M.CAUSAL, M.CAUSAL],
+        ),
+    }
+    # one kv row of k + v at bf16, serving-ish head geometry
+    hk, d = 8, 128
+    row_bytes = 2 * hk * d * 2
+    dcn_per_row = 8.0
+
+    rows = []
+    for name, (qr_l, kr_l, tm) in families.items():
+        qr = AttnRanges.from_ranges(qr_l)
+        kr = AttnRanges.from_ranges(kr_l)
+        for n_outer, n_inner in ((2, 4), (4, 2)):
+            cp = n_outer * n_inner
+            cfg = DistAttnConfig(overlap_config=OverlapConfig(degree=2))
+            mq, mkv, bucket = make_dispatch_meta_from_qk_ranges(
+                qr, kr, list(tm), seq, seq, chunk, cp, cfg.dispatch_config
+            )
+            cmm, calc = make_attn_meta_from_dispatch_meta(
+                bucket, mq, cfg, dispatch_meta_kv=mkv,
+                mesh_shape=(n_outer, n_inner),
+            )
+            flat_dcn = dcn = 0
+            costs = []
+            for st, s in enumerate(cmm.kv_stages):
+                flat_dcn += sum(
+                    s.transfer_table[dst][src].total_seqlen
+                    for dst in range(cp)
+                    for src in range(cp)
+                    if dst // n_inner != src // n_inner
+                )
+                dcn += s.hier_plan.dcn_rows()
+                per_rank_recv = [int(x) for x in s.recv_len]
+                per_rank_area = [
+                    int(a.area())
+                    for a in calc.remote_args_per_stage[st]
+                ]
+                costs.append(OverlapStageCost(
+                    comm_cost=float(max(per_rank_recv, default=0)),
+                    calc_cost=float(
+                        max(per_rank_area, default=0) / chunk
+                    ),
+                    dcn_cost=(
+                        s.hier_plan.dcn_rows() / cp * dcn_per_row
+                    ),
+                ))
+            host_calc = max(
+                (int(a.area()) for a in calc.host_args), default=0
+            ) / chunk
+            row = {
+                "mask": name,
+                "mesh": f"{n_outer}x{n_inner}",
+                "seq": seq,
+                "stages": len(cmm.kv_stages),
+                "flat_dcn_rows": int(flat_dcn),
+                "dcn_rows": int(dcn),
+                "dcn_bytes": int(dcn) * row_bytes,
+                "flat_dcn_bytes": int(flat_dcn) * row_bytes,
+                "dcn_dedup_ratio": round(flat_dcn / dcn, 3) if dcn else 1.0,
+                # acceptance: post-dedup DCN volume never exceeds the
+                # flat plan's cross-node volume
+                "dcn_ok": bool(dcn <= flat_dcn),
+                "flat_makespan": round(pipeline_makespan(costs, host_calc), 1),
+                "two_level_makespan": round(
+                    two_level_makespan(costs, host_calc), 1
+                ),
+            }
+            rows.append(row)
+
+    try:
+        from magiattention_tpu.benchmarking.perf_report import append_row
+
+        for row in rows:
+            append_row("bench_dcn", row)
+    except Exception:
+        pass
+    return _emit({
+        "metric": "dcn_suite",
+        "backend": jax.default_backend(),
+        "ok": all(r["dcn_ok"] for r in rows),
+        "rows": rows,
+    })
+
+
+# ---------------------------------------------------------------------------
 # parent: subprocess isolation + bounded retry + degraded-output path
 # ---------------------------------------------------------------------------
 
@@ -762,4 +886,6 @@ if __name__ == "__main__":
         sys.exit(run_sparse_suite())
     if "--bwd-suite" in sys.argv:
         sys.exit(run_bwd_suite())
+    if "--dcn-suite" in sys.argv:
+        sys.exit(run_dcn_suite())
     sys.exit(run_worker() if "--worker" in sys.argv else main())

@@ -370,6 +370,158 @@ def check_group_collective_arg(
                     )
 
 
+def check_hier_plan(report, plan, arg, host_ranges, site: str) -> None:
+    """R3 fabric-split sub-check for a two-level (DCN x ICI) stage plan.
+
+    First-principles simulation with global row ids: run the phase-A
+    gather/exchange over the dcn axis, then the phase-B forwarding over the
+    ici axis, and require the final receive buffer to reconstruct the flat
+    plan's receive buffer row-for-row (the phase-A + phase-B row multisets
+    are exactly the flat sends — zero-redundancy preserved across fabrics).
+    Additionally, every cross-node (dst node, src) row must cross the DCN
+    exactly once, and intra-node rows must never touch it.
+    """
+    report.mark_run("R3")
+    n_outer, n_inner = plan.n_outer, plan.n_inner
+    cp = plan.cp_size
+    if cp != arg.send_counts.shape[0] or n_outer * n_inner != cp:
+        report.add(
+            "R3", ERROR, site,
+            f"hier plan geometry ({n_outer}x{n_inner}) inconsistent with "
+            f"the stage's cp {arg.send_counts.shape[0]}",
+        )
+        return
+
+    # per-rank global row ids of the kv shard (locator order), -1 padded
+    shard_ids = np.full((cp, plan.shard_len), -1, dtype=np.int64)
+    for r in range(cp):
+        chunks = [
+            np.arange(g.start, g.end, dtype=np.int64) for g in host_ranges[r]
+        ]
+        flat = (
+            np.concatenate(chunks) if chunks else np.zeros(0, dtype=np.int64)
+        )
+        if flat.size > plan.shard_len:
+            report.add(
+                "R3", ERROR, site,
+                f"rank {r} owns {flat.size} rows > hier shard_len "
+                f"{plan.shard_len}",
+            )
+            return
+        shard_ids[r, : flat.size] = flat
+
+    # phase A over the dcn axis: rank (o_s, i) -> aligned peer (o_d, i)
+    a_cap = plan.a_send_idx.shape[2]
+    ra = plan.a_recv_sel.shape[1]
+    recv_a = np.full((cp, ra), -1, dtype=np.int64)
+    crossed: dict[tuple[int, int], np.ndarray] = {}  # (dst_node, src) -> gids
+    for r in range(cp):
+        o_d, i = divmod(r, n_inner)
+        n = int(plan.a_recv_len[r])
+        if n == 0:
+            continue
+        sel = np.asarray(plan.a_recv_sel[r, :n], dtype=np.int64)
+        if sel.min() < 0 or sel.max() >= n_outer * a_cap:
+            report.add(
+                "R3", ERROR, f"{site} hier phase A dst {r}",
+                "a_recv_sel index outside the (n_outer * a_cap) buffer",
+            )
+            return
+        o_s, pos = sel // a_cap, sel % a_cap
+        src = o_s * n_inner + i
+        local = np.asarray(plan.a_send_idx, dtype=np.int64)[src, o_d, pos]
+        gids = shard_ids[src, local]
+        recv_a[r, :n] = gids
+        for s in np.unique(src):
+            got = gids[src == s]
+            key = (o_d, int(s))
+            crossed[key] = (
+                np.concatenate([crossed[key], got]) if key in crossed else got
+            )
+
+    # exactly-once DCN crossing per (dst node, src): the phase-A rows must
+    # be the dedup-merged union of the node's flat requests from that src
+    total_dcn = 0
+    for o_d in range(n_outer):
+        for src in range(cp):
+            expect_ranges = AttnRanges()
+            for d in range(o_d * n_inner, (o_d + 1) * n_inner):
+                for g in arg.transfer_table[d][src]:
+                    expect_ranges.append(g)
+            expect = (
+                np.concatenate(
+                    [
+                        np.arange(g.start, g.end, dtype=np.int64)
+                        for g in expect_ranges.merge()
+                    ]
+                )
+                if len(expect_ranges)
+                else np.zeros(0, dtype=np.int64)
+            )
+            got = np.sort(crossed.get((o_d, src), np.zeros(0, np.int64)))
+            if src // n_inner == o_d:
+                if got.size:
+                    report.add(
+                        "R3", ERROR, f"{site} hier node {o_d} src {src}",
+                        f"{got.size} intra-node rows crossed the DCN",
+                    )
+                continue
+            total_dcn += got.size
+            if got.size != expect.size or (
+                got.size and (got != np.sort(expect)).any()
+            ):
+                report.add(
+                    "R3", ERROR, f"{site} hier node {o_d} src {src}",
+                    f"phase-A rows ({got.size}) are not the exactly-once "
+                    f"dedup of the node's flat requests ({expect.size})",
+                )
+    if total_dcn != plan.dcn_rows():
+        report.add(
+            "R3", ERROR, site,
+            f"dcn_rows() {plan.dcn_rows()} != simulated DCN crossings "
+            f"{total_dcn}",
+        )
+
+    # phase B over the ici axis from [shard | recv_a], then byte-identity
+    # of the final buffer with the flat plan's receive buffer
+    buf_ids = np.concatenate([shard_ids, recv_a], axis=1)
+    b_cap = plan.b_send_idx.shape[2]
+    b_send = np.asarray(plan.b_send_idx, dtype=np.int64)
+    for dst in range(cp):
+        o, i_d = divmod(dst, n_inner)
+        n = int(arg.recv_len[dst])
+        if n == 0:
+            continue
+        sel = np.asarray(plan.b_recv_sel[dst, :n], dtype=np.int64)
+        if sel.min() < 0 or sel.max() >= n_inner * b_cap:
+            report.add(
+                "R3", ERROR, f"{site} hier phase B dst {dst}",
+                "b_recv_sel index outside the (n_inner * b_cap) buffer",
+            )
+            return
+        i_s, pos = sel // b_cap, sel % b_cap
+        src = o * n_inner + i_s
+        local = b_send[src, i_d, pos]
+        if local.size and local.max() >= buf_ids.shape[1]:
+            report.add(
+                "R3", ERROR, f"{site} hier phase B dst {dst}",
+                "b_send_idx beyond the [shard | phase-A recv] buffer",
+            )
+            return
+        final = buf_ids[src, local]
+        fsel = np.asarray(arg.recv_sel[dst, :n], dtype=np.int64)
+        fsrc, fpos = fsel // arg.a_cap, fsel % arg.a_cap
+        flat = shard_ids[
+            fsrc, np.asarray(arg.send_idx, dtype=np.int64)[fsrc, dst, fpos]
+        ]
+        if (final != flat).any():
+            report.add(
+                "R3", ERROR, f"{site} hier dst {dst}",
+                f"{int((final != flat).sum())} rows of the two-phase "
+                "receive buffer diverge from the flat plan's buffer",
+            )
+
+
 def _remote_demand(bucket, dispatch_meta, kv_own: AttnRanges, rank: int):
     """Global kv rows rank's slices need but the rank does not own."""
     chunks_by_id = {c.chunk_id: c for c in bucket.q_chunks}
@@ -719,6 +871,12 @@ def verify_plan(
                 ),
                 src_host_ranges=kv_ranges,
             )
+            if getattr(s, "hier_plan", None) is not None and (
+                kv_ranges is not None
+            ):
+                check_hier_plan(
+                    report, s.hier_plan, s, kv_ranges, f"kv_stage{st}"
+                )
         if dispatch_meta is not None and bucket is not None:
             check_comm_demand(
                 report, comm_meta, dispatch_meta, bucket,

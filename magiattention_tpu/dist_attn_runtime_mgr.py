@@ -38,11 +38,32 @@ def _plan_build_retries() -> int:
 
 
 def _mesh_signature(mesh: Mesh) -> tuple:
+    """Canonical mesh identity: per-axis (name, size) pairs + device ids.
+
+    Pairing name with size (instead of separate name/shape tuples) keeps a
+    flat cp=8 mesh and a 2x4 two-level (dcn, ici) mesh from ever colliding,
+    and makes the axis-extent lookup for two-level planning unambiguous."""
     return (
-        tuple(mesh.axis_names),
-        tuple(mesh.devices.shape),
+        tuple(zip(mesh.axis_names, mesh.devices.shape)),
         tuple(d.id for d in mesh.devices.flat),
     )
+
+
+def _mesh_shape_for(key: "DistAttnRuntimeKey", mesh: Mesh) -> tuple[int, int] | None:
+    """(n_outer, n_inner) for two-level planning, or None on flat meshes.
+
+    Two-level plans are built exactly when the runtime will execute them:
+    tuple cp_axis + MAGI_ATTENTION_HIERARCHICAL_COMM=1 (both are part of
+    the cache keys, so flat and two-level plans never mix)."""
+    from .env import comm as env_comm
+
+    if (
+        isinstance(key.cp_axis, tuple)
+        and env_comm.is_hierarchical_comm_enable()
+    ):
+        dcn_axis, ici_axis = key.cp_axis
+        return (int(mesh.shape[dcn_axis]), int(mesh.shape[ici_axis]))
+    return None
 
 
 @dataclass(frozen=True)
@@ -67,6 +88,98 @@ class DistAttnRuntimeKey:
     fixed_partitions: tuple[tuple[int, ...], ...] | None = None
 
 
+def _plan_signature(key: DistAttnRuntimeKey) -> tuple:
+    """Everything the host-side solved plan depends on.
+
+    The runtime key minus the parts that only affect traced execution:
+    device ids (mesh_sig[1] — the same plan is valid on any device
+    assignment of the same axis layout) and head_axis (TP sharding of the
+    already-solved plan)."""
+    return (
+        key.q_ranges,
+        key.k_ranges,
+        key.attn_mask_type,
+        key.total_seqlen_q,
+        key.total_seqlen_k,
+        key.chunk_size,
+        key.cp_size,
+        key.cp_axis,
+        key.mesh_sig[0],
+        key.config,
+        key.env_snapshot,
+        key.fixed_partitions,
+    )
+
+
+def _mask_family(sig: tuple) -> tuple:
+    """Signature minus the mask itself (q/k ranges + types): dynamic-mask
+    steps of the same workload share a family, so a new signature can seed
+    its incremental re-solve from the family's previous solve state."""
+    return sig[3:]
+
+
+class _PlanCache:
+    """Mask-signature-keyed solved-plan LRU, one level below the runtime
+    LRU (DistAttnRuntimeDict).
+
+    The runtime LRU caches traced managers per full runtime key; this cache
+    holds only the host-solved artifacts (dispatch metas + static attn
+    metas / dynamic plan), so a repeated mask signature skips every solver
+    pass even when the traced runtime was evicted or is keyed differently
+    (e.g. same plan on a different device assignment). It also remembers
+    each mask family's latest dynamic solve state to seed incremental
+    re-solves on a miss. Reuse is exact — a hit returns the identical plan
+    objects a cold solve produced — and every reusing manager still runs
+    the R1-R5 verifier on its plan (MAGI_ATTENTION_VERIFY_PLANS=1)."""
+
+    def __init__(self) -> None:
+        self._d: OrderedDict[tuple, dict] = OrderedDict()
+        self._prev_dyn: dict[tuple, Any] = {}
+        self._hits = 0
+        self._misses = 0
+
+    def lookup(self, sig: tuple) -> dict | None:
+        if sig in self._d:
+            self._d.move_to_end(sig)
+            self._hits += 1
+            telemetry.inc("plan_solve.cache_hit")
+            return self._d[sig]
+        self._misses += 1
+        telemetry.inc("plan_solve.cache_miss")
+        return None
+
+    def store(self, sig: tuple, entry: dict) -> None:
+        self._d[sig] = entry
+        self._d.move_to_end(sig)
+        while len(self._d) > env_general.plan_cache_size():
+            self._d.popitem(last=False)
+
+    def prev_dyn_state(self, family: tuple):
+        return self._prev_dyn.get(family)
+
+    def set_dyn_state(self, family: tuple, state) -> None:
+        if state is not None:
+            self._prev_dyn[family] = state
+
+    def get_stats(self) -> dict[str, int]:
+        return {
+            "hits": self._hits,
+            "misses": self._misses,
+            "size": len(self._d),
+        }
+
+    def clear(self) -> None:
+        self._d.clear()
+        self._prev_dyn.clear()
+        self._hits = 0
+        self._misses = 0
+
+
+# module-level: plans outlive any one runtime dict (api/magi_attn_interface
+# builds one DistAttnRuntimeDict; tests may build their own)
+_PLAN_CACHE = _PlanCache()
+
+
 class DistAttnRuntimeMgr:
     """Owns metas + runtime for one key (ref :164-483)."""
 
@@ -77,23 +190,34 @@ class DistAttnRuntimeMgr:
         k_ranges = AttnRanges.from_ranges(key.k_ranges)
         mask_types = [AttnMaskType.from_int_type(t) for t in key.attn_mask_type]
 
-        self.dispatch_meta_q, self.dispatch_meta_kv, self.bucket = (
-            make_dispatch_meta_from_qk_ranges(
-                q_ranges,
-                k_ranges,
-                mask_types,
-                key.total_seqlen_q,
-                key.total_seqlen_k,
-                key.chunk_size,
-                key.cp_size,
-                key.config.dispatch_config,
-                preset_partitions=(
-                    [list(p) for p in key.fixed_partitions]
-                    if key.fixed_partitions is not None
-                    else None
-                ),
+        cache_on = env_general.is_plan_cache_enable()
+        sig = _plan_signature(key) if cache_on else None
+        entry = _PLAN_CACHE.lookup(sig) if cache_on else None
+
+        if entry is not None:
+            # solved-plan cache hit: the whole solver pipeline (dispatch +
+            # attn plan) is skipped; verification below still runs
+            self.dispatch_meta_q, self.dispatch_meta_kv, self.bucket = (
+                entry["dispatch"]
             )
-        )
+        else:
+            self.dispatch_meta_q, self.dispatch_meta_kv, self.bucket = (
+                make_dispatch_meta_from_qk_ranges(
+                    q_ranges,
+                    k_ranges,
+                    mask_types,
+                    key.total_seqlen_q,
+                    key.total_seqlen_k,
+                    key.chunk_size,
+                    key.cp_size,
+                    key.config.dispatch_config,
+                    preset_partitions=(
+                        [list(p) for p in key.fixed_partitions]
+                        if key.fixed_partitions is not None
+                        else None
+                    ),
+                )
+            )
         from .env import comm as env_comm
 
         if env_comm.is_qo_comm_enable():
@@ -116,25 +240,56 @@ class DistAttnRuntimeMgr:
                     "MAGI_ATTENTION_HIERARCHICAL_COMM=1 yet; unset one"
                 )
 
-            try:
-                self.dynamic_plan = make_dynamic_attn_plan(
-                    q_ranges, k_ranges, mask_types,
-                    self.dispatch_meta_q, key.config,
-                    dispatch_meta_kv=self.dispatch_meta_kv,
-                )
-            except Exception as e:
-                # degradation chain 2 (docs/resilience.md): a failed
-                # dynamic solve falls back to the static solver plan —
-                # same mask, kv-comm execution instead of qo-comm
-                if not env_resilience.is_fallback_enable():
-                    raise
-                from .resilience.fallback import record_resilience_event
-
-                record_resilience_event(
-                    "fallback", "dynamic_plan_solve",
-                    action_detail="static_plan", error=type(e).__name__,
-                )
+            cached_plan = entry.get("dynamic") if entry is not None else None
+            if cached_plan is not None:
+                self.dynamic_plan = cached_plan
+                if telemetry.enabled():
+                    telemetry.record_event(
+                        "plan_solve", planner="dynamic", event="cache_hit",
+                        incremental=False, wall_ms=0.0, rows_resolved=0,
+                    )
+                built_dynamic = True
             else:
+                built_dynamic = False
+                try:
+                    self.dynamic_plan = make_dynamic_attn_plan(
+                        q_ranges, k_ranges, mask_types,
+                        self.dispatch_meta_q, key.config,
+                        dispatch_meta_kv=self.dispatch_meta_kv,
+                        prev_state=(
+                            _PLAN_CACHE.prev_dyn_state(_mask_family(sig))
+                            if cache_on
+                            else None
+                        ),
+                    )
+                except Exception as e:
+                    # degradation chain 2 (docs/resilience.md): a failed
+                    # dynamic solve falls back to the static solver plan —
+                    # same mask, kv-comm execution instead of qo-comm
+                    if not env_resilience.is_fallback_enable():
+                        raise
+                    from .resilience.fallback import record_resilience_event
+
+                    record_resilience_event(
+                        "fallback", "dynamic_plan_solve",
+                        action_detail="static_plan", error=type(e).__name__,
+                    )
+                else:
+                    built_dynamic = True
+                    if cache_on:
+                        _PLAN_CACHE.store(sig, {
+                            "dispatch": (
+                                self.dispatch_meta_q,
+                                self.dispatch_meta_kv,
+                                self.bucket,
+                            ),
+                            "dynamic": self.dynamic_plan,
+                        })
+                        _PLAN_CACHE.set_dyn_state(
+                            _mask_family(sig),
+                            self.dynamic_plan.solver_state,
+                        )
+            if built_dynamic:
                 self.comm_meta = self.calc_meta = None
                 self.runtime = DynamicDistAttnRuntime(
                     plan=self.dynamic_plan, mesh=mesh, cp_axis=key.cp_axis
@@ -159,10 +314,27 @@ class DistAttnRuntimeMgr:
                 return
 
         self.dynamic_plan = None
-        self.comm_meta, self.calc_meta = make_attn_meta_from_dispatch_meta(
-            self.bucket, self.dispatch_meta_q, key.config,
-            dispatch_meta_kv=self.dispatch_meta_kv,
-        )
+        cached_metas = entry.get("static") if entry is not None else None
+        if cached_metas is not None:
+            self.comm_meta, self.calc_meta = cached_metas
+            if telemetry.enabled():
+                telemetry.record_event(
+                    "plan_solve", planner="static", event="cache_hit",
+                    incremental=False, wall_ms=0.0, rows_resolved=0,
+                )
+        else:
+            self.comm_meta, self.calc_meta = make_attn_meta_from_dispatch_meta(
+                self.bucket, self.dispatch_meta_q, key.config,
+                dispatch_meta_kv=self.dispatch_meta_kv,
+                mesh_shape=_mesh_shape_for(key, mesh),
+            )
+            if cache_on:
+                new_entry = dict(entry) if entry is not None else {}
+                new_entry["dispatch"] = (
+                    self.dispatch_meta_q, self.dispatch_meta_kv, self.bucket
+                )
+                new_entry["static"] = (self.comm_meta, self.calc_meta)
+                _PLAN_CACHE.store(sig, new_entry)
         overlap_cfg = key.config.overlap_config
         self.runtime = DistAttnRuntime(
             comm_meta=self.comm_meta,

@@ -80,6 +80,29 @@ def runtime_dict_size() -> int:
     return _get_int("MAGI_ATTENTION_RUNTIME_DICT_SIZE", 100)
 
 
+def is_plan_cache_enable() -> bool:
+    """Solved-plan cache one level below the traced-runtime LRU
+    (dist_attn_runtime_mgr.py): repeated mask signatures skip the solver
+    entirely; a miss still seeds the next incremental re-solve. Reuse never
+    changes which plan is produced for a signature, so (like
+    MAGI_ATTENTION_VERIFY_PLANS) this is not a runtime-cache-key flag."""
+    return _get_bool("MAGI_ATTENTION_PLAN_CACHE", default=True)
+
+
+def plan_cache_size() -> int:
+    """LRU capacity of the solved-plan cache (entries = mask signatures)."""
+    return _get_int("MAGI_ATTENTION_PLAN_CACHE_SIZE", 100)
+
+
+def is_incremental_solve_enable() -> bool:
+    """Dynamic-solver incremental re-solve: diff the mask's rectangles
+    against the previous solve's state and re-run the assignment algorithm
+    only on changed rectangles (meta/solver/dynamic_attn_solver.py). May
+    produce a different (equally verified) plan than a cold solve, so it IS
+    part of the runtime cache key."""
+    return _get_bool("MAGI_ATTENTION_INCREMENTAL_SOLVE", default=True)
+
+
 def min_chunks_per_rank() -> int:
     """Lower bound on dispatch chunks per rank when auto-deriving chunk_size
     (api/magi_attn_interface.py _auto_chunk_size; ref env/general.py:263 —
@@ -154,6 +177,10 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     "MAGI_ATTENTION_PALLAS_INTERPRET",
     "MAGI_ATTENTION_QO_COMM",
     "MAGI_ATTENTION_HIERARCHICAL_COMM",
+    # incremental re-solve can legitimately pick a different (verified)
+    # assignment than a cold solve (MAGI_ATTENTION_PLAN_CACHE only reuses
+    # identical plans — excluded, same precedent as VERIFY_PLANS)
+    "MAGI_ATTENTION_INCREMENTAL_SOLVE",
     "MAGI_ATTENTION_FFA_BLOCK_Q",
     "MAGI_ATTENTION_FFA_BLOCK_K",
     "MAGI_ATTENTION_FFA_BLOCK_Q_DQ",

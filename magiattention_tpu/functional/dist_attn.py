@@ -435,9 +435,13 @@ class DistAttnRuntime(DeferredTilePolicy):
             and cm.kv_host_ranges is not None
         )
         if self._hier:
-            # re-plan each stage 2-phase from its transfer table; the final
+            # each stage runs the 2-phase (DCN x ICI) cast; the final
             # receive buffer is flat-identical (comm/hier.py), so CalcMeta
-            # is untouched
+            # is untouched. Solver-built plans (s.hier_plan, emitted when
+            # the solver knew the 2D mesh shape) are used directly — they
+            # were cached and verified with the rest of the plan; stages
+            # planned without a mesh shape are re-planned here from their
+            # transfer tables (identical construction)
             from ..comm.hier import make_hier_group_cast_plan
 
             dcn_axis, ici_axis = self.cp_axis
@@ -445,10 +449,17 @@ class DistAttnRuntime(DeferredTilePolicy):
             n_inner = self.mesh.shape[ici_axis]
             self._hier_arrays = []
             for st, s in enumerate(cm.kv_stages):
-                plan = make_hier_group_cast_plan(
-                    s.transfer_table, cm.kv_host_ranges, n_outer, n_inner,
-                    alignment=128, r_max=s.r_max, shard_len=kv_shard,
-                )
+                plan = s.hier_plan
+                if (
+                    plan is None
+                    or plan.n_outer != n_outer
+                    or plan.n_inner != n_inner
+                ):
+                    plan = make_hier_group_cast_plan(
+                        s.transfer_table, cm.kv_host_ranges, n_outer,
+                        n_inner, alignment=128, r_max=s.r_max,
+                        shard_len=kv_shard,
+                    )
                 self._hier_arrays.append(tuple(
                     jnp.asarray(a) for a in (
                         plan.a_send_idx, plan.a_recv_sel,

@@ -24,6 +24,7 @@ def make_attn_meta_from_dispatch_meta(
     dispatch_meta: DispatchMeta,
     config: DistAttnConfig | None = None,
     dispatch_meta_kv: DispatchMeta | None = None,
+    mesh_shape: tuple[int, int] | None = None,
 ) -> tuple[CommMeta, CalcMeta]:
     maybe_inject("comm_plan_build")
     config = config or DistAttnConfig()
@@ -33,6 +34,7 @@ def make_attn_meta_from_dispatch_meta(
         overlap_config=config.overlap_config,
         split_alignment=config.grpcoll_config.split_alignment,
         dispatch_meta_kv=dispatch_meta_kv,
+        mesh_shape=mesh_shape,
     )
     return solver.solve()
 
@@ -44,9 +46,15 @@ def make_dynamic_attn_plan(
     dispatch_meta: DispatchMeta,
     config: DistAttnConfig | None = None,
     dispatch_meta_kv: DispatchMeta | None = None,
+    prev_state=None,
 ) -> DynamicAttnPlan:
     """Build the qo-comm plan from global mask metadata (ref
-    dynamic_attn_solver.py:236 solve — rectangles-based global assignment)."""
+    dynamic_attn_solver.py:236 solve — rectangles-based global assignment).
+
+    ``prev_state`` (a DynSolveState from a previous step's solve) enables
+    the incremental re-solve: rectangles unchanged since the previous mask
+    keep their rank assignment and only new ones run the algorithm.
+    """
     from .solver.dynamic_attn_solver import DynamicAttnSolver
 
     maybe_inject("dynamic_plan_solve")
@@ -59,4 +67,4 @@ def make_dynamic_attn_plan(
         alg=config.dynamic_config.alg,
         split_alignment=config.grpcoll_config.split_alignment,
     )
-    return solver.solve()
+    return solver.solve(prev_state=prev_state)

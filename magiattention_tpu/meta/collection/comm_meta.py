@@ -19,10 +19,14 @@ group_reduce/_reduce_partial_dkv machinery, dist_attn.py:2123).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
 
 import numpy as np
 
 from ...common.ranges import AttnRanges
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (comm -> meta)
+    from ...comm.hier import HierGroupCastPlan
 
 
 @dataclass
@@ -62,6 +66,10 @@ class GroupCollectiveArg:
     pp_send_idx: np.ndarray | None = None  # (cp, sum_caps) int32
     pp_recv_sel: np.ndarray | None = None  # (cp, R_max) int32
     lowering: str = "a2a"  # chosen wire lowering for this stage
+    # two-level plans: the solver-built phase-A/phase-B split for this stage
+    # on a (dcn, ici) mesh. None on flat meshes; when set, the runtime uses
+    # it directly instead of re-planning from the transfer table.
+    hier_plan: "HierGroupCastPlan | None" = None
 
     def total_send_rows(self) -> int:
         return int(self.send_counts.sum())
@@ -111,7 +119,7 @@ class GroupCollectiveArg:
             else self.wire_rows(self.lowering)  # e.g. hier: flat # is a bound
         )
         payload = self.payload_rows()
-        return {
+        out = {
             "lowering_planned": self.lowering,
             "lowering_executed": kind,
             "payload_rows": payload,
@@ -124,6 +132,9 @@ class GroupCollectiveArg:
             "send_rows_per_rank": self.send_counts.sum(axis=1).tolist(),
             "recv_rows_per_rank": self.recv_len.tolist(),
         }
+        if self.hier_plan is not None:
+            out["dcn_rows"] = self.hier_plan.dcn_rows()
+        return out
 
 
 def pick_lowering(arg: GroupCollectiveArg) -> str:

@@ -47,6 +47,10 @@ class DynamicAttnPlan:
     q_buf_len: int
     k_buf_len: int
     ret_len: int
+    # solver carryover (DynSolveState): the input rectangles + per-rank tile
+    # buckets behind this plan, fed back as prev_state for the next step's
+    # incremental re-solve. Not part of the executable contract.
+    solver_state: object | None = None
 
     @property
     def cp_size(self) -> int:

@@ -24,10 +24,12 @@ All of this is deterministic host code computed identically on every rank
 from __future__ import annotations
 
 import bisect
+import time
 from dataclasses import dataclass
 
 import numpy as np
 
+from ... import telemetry
 from ...common.range import AttnRange, RangeError
 from ...common.ranges import AttnRanges
 from ...config import OverlapConfig
@@ -109,6 +111,7 @@ class DistAttnSolver:
         overlap_config: OverlapConfig | None = None,
         split_alignment: int = 128,
         dispatch_meta_kv: DispatchMeta | None = None,
+        mesh_shape: tuple[int, int] | None = None,
     ) -> None:
         self.bucket = bucket
         self.meta = dispatch_meta
@@ -117,10 +120,24 @@ class DistAttnSolver:
         self.cp_size = dispatch_meta.cp_size
         self.overlap_config = overlap_config or OverlapConfig()
         self.split_alignment = split_alignment
+        # two-level (dcn, ici) mesh: (n_outer, n_inner), ranks outer-major.
+        # When set (and consistent with cp_size), every stage also gets a
+        # phase-A/phase-B hier plan and the overlap solver prices the DCN
+        # fabric separately.
+        if mesh_shape is not None and (
+            len(mesh_shape) != 2
+            or mesh_shape[0] * mesh_shape[1] != self.cp_size
+        ):
+            raise ValueError(
+                f"mesh_shape {mesh_shape} inconsistent with cp_size "
+                f"{self.cp_size}"
+            )
+        self.mesh_shape = mesh_shape
 
     # ------------------------------------------------------------------
 
     def solve(self) -> tuple[CommMeta, CalcMeta]:
+        t0 = time.perf_counter()
         cp = self.cp_size
         meta = self.meta
         shard_len = meta.shard_seqlen
@@ -325,6 +342,21 @@ class DistAttnSolver:
                 )
             )
 
+        # two-level mesh: split each stage by fabric up front — the same
+        # phase-A/phase-B plan the runtime would otherwise rebuild per
+        # stage from the transfer table (functional/dist_attn.py), built
+        # once here so it is cached and verified with the rest of the plan
+        if self.mesh_shape is not None:
+            from ...comm.hier import make_hier_group_cast_plan
+
+            n_outer, n_inner = self.mesh_shape
+            for s_arg in kv_stages:
+                s_arg.hier_plan = make_hier_group_cast_plan(
+                    s_arg.transfer_table, kv_ranges, n_outer, n_inner,
+                    alignment=128, r_max=s_arg.r_max,
+                    shard_len=kv_shard_len,
+                )
+
         total_recv = sum(stage_recv_len)
         calc_meta = CalcMeta(
             host_args=[
@@ -358,6 +390,21 @@ class DistAttnSolver:
         if is_sanity_check_enable():
             _sanity_check_plan(
                 comm_meta, calc_meta, kv_ranges, self.bucket, meta
+            )
+        if telemetry.enabled():
+            rows_total = sum(
+                iv.grange.seqlen for ivs in intervals for iv in ivs
+            )
+            telemetry.record_event(
+                "plan_solve",
+                planner="static",
+                event="solve",
+                incremental=False,
+                wall_ms=(time.perf_counter() - t0) * 1e3,
+                rows_total=rows_total,
+                rows_resolved=rows_total,
+                two_level=self.mesh_shape is not None,
+                stages=degree,
             )
         return comm_meta, calc_meta
 
@@ -428,11 +475,25 @@ class DistAttnSolver:
         from .overlap_solver import OverlapItem, OverlapSolver
 
         solver = OverlapSolver(self.overlap_config)
-        for ivs in intervals:
+        # two-level mesh: an interval whose source sits on another node
+        # must cross DCN in phase A — price those rows on the slow fabric
+        # so the dynamic-degree sweep can pipeline them under ICI stages
+        # (post-dedup the true volume is lower; this is the per-rank bound)
+        n_inner = self.mesh_shape[1] if self.mesh_shape is not None else 0
+        for dst, ivs in enumerate(intervals):
             if not ivs:
                 continue
             items = [
-                OverlapItem(rows=iv.grange.seqlen, area=iv.area) for iv in ivs
+                OverlapItem(
+                    rows=iv.grange.seqlen,
+                    area=iv.area,
+                    dcn_rows=(
+                        iv.grange.seqlen
+                        if n_inner and iv.src // n_inner != dst // n_inner
+                        else 0
+                    ),
+                )
+                for iv in ivs
             ]
             assign, _ = solver.solve(items)
             for iv, st in zip(ivs, assign):

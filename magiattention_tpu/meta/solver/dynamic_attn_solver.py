@@ -23,12 +23,16 @@ All of it is deterministic host code computed identically on every rank.
 
 from __future__ import annotations
 
+import time
+from dataclasses import dataclass
+
 import numpy as np
 
+from ... import telemetry
 from ...common.enum import DynamicAttnAlgType
 from ...common.range import AttnRange, RangeError
 from ...common.ranges import AttnRanges
-from ...common.rectangle import AttnRectangles
+from ...common.rectangle import AttnRectangle, AttnRectangles
 from ...kernels.mask_utils import BAND_INF
 from ..collection.calc_meta import AttnArg
 from ..collection.comm_meta import GroupCollectiveArg
@@ -39,6 +43,44 @@ from .algorithms import DynSolveContext, get_dynamic_alg
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _rect_key(rc: AttnRectangle) -> tuple[int, ...]:
+    """Exact identity of one input rectangle (the mask-diff unit)."""
+    return (
+        rc.q_range.start, rc.q_range.end,
+        rc.k_range.start, rc.k_range.end,
+        rc.d_lo, rc.d_hi,
+    )
+
+
+def _rect_contains(rc: AttnRectangle, tile: AttnRectangle) -> bool:
+    """Is ``tile`` an ownership-cut piece of input rectangle ``rc``?
+
+    cut_to_tiles truncates only q/k ranges (never the band), so a tile
+    belongs to rc iff both ranges are contained and the band matches."""
+    return (
+        tile.d_lo == rc.d_lo
+        and tile.d_hi == rc.d_hi
+        and tile.q_range.start >= rc.q_range.start
+        and tile.q_range.end <= rc.q_range.end
+        and tile.k_range.start >= rc.k_range.start
+        and tile.k_range.end <= rc.k_range.end
+    )
+
+
+@dataclass
+class DynSolveState:
+    """Carryover from one dynamic solve to the next.
+
+    Holds the solved mask's input rectangles and the per-rank tile buckets
+    the algorithm produced, so the next step can diff its mask against this
+    one and re-run the assignment algorithm only on rectangles that
+    actually changed (the plan-rebuild passes always run in full — they are
+    cheap next to the assignment search)."""
+
+    rects: list[AttnRectangle]
+    buckets: list[AttnRectangles]
 
 
 class _BufSeg:
@@ -72,10 +114,61 @@ class DynamicAttnSolver:
         self.alg_kwargs = alg_kwargs
         self.split_alignment = split_alignment
         self.bucket_per_rank: list[AttnRectangles] | None = None
+        # post-solve carryover for the next step's incremental re-solve
+        self.state: DynSolveState | None = None
 
     # ------------------------------------------------------------------
 
-    def solve(self) -> DynamicAttnPlan:
+    def _incremental_buckets(
+        self, ctx: DynSolveContext, prev: DynSolveState, algorithm
+    ) -> tuple[list[AttnRectangles], int] | None:
+        """Diff this mask against ``prev`` and reuse its assignment.
+
+        Tiles of unchanged rectangles keep their previous rank; only added
+        rectangles run the assignment algorithm. Returns (buckets, rows
+        re-solved), or None when attribution is ambiguous (duplicate or
+        overlapping rectangles) — the caller then falls back to a cold
+        solve, which is always safe."""
+        prev_by_key: dict[tuple[int, ...], AttnRectangle] = {}
+        for rc in prev.rects:
+            k = _rect_key(rc)
+            if k in prev_by_key:
+                return None
+            prev_by_key[k] = rc
+        new_keys: set[tuple[int, ...]] = set()
+        added: list[AttnRectangle] = []
+        for rc in self.rects:
+            k = _rect_key(rc)
+            if k in new_keys:
+                return None
+            new_keys.add(k)
+            if k not in prev_by_key:
+                added.append(rc)
+        unchanged = new_keys & prev_by_key.keys()
+
+        # attribute every previously assigned tile to its source rectangle;
+        # tiles of unchanged rectangles are kept in place, tiles of removed
+        # rectangles are dropped
+        kept = [AttnRectangles() for _ in range(ctx.cp_size)]
+        for r, bucket in enumerate(prev.buckets):
+            for tile in bucket:
+                matches = [
+                    k for k, rc in prev_by_key.items()
+                    if _rect_contains(rc, tile)
+                ]
+                if len(matches) != 1:
+                    return None
+                if matches[0] in unchanged:
+                    kept[r].append(tile)
+        if added:
+            add_buckets = algorithm.solve(AttnRectangles(added), ctx)
+            for r in range(ctx.cp_size):
+                kept[r].extend(add_buckets[r])
+        resolved = sum(rc.q_range.seqlen for rc in added)
+        return kept, resolved
+
+    def solve(self, prev_state: DynSolveState | None = None) -> DynamicAttnPlan:
+        t0 = time.perf_counter()
         cp = self.cp_size
         host_q = [r.merge() for r in self.meta_q.host_ranges_per_rank]
         host_k = [r.merge() for r in self.meta_kv.host_ranges_per_rank]
@@ -83,8 +176,24 @@ class DynamicAttnSolver:
             host_ranges_q=host_q, host_ranges_k=host_k, cp_size=cp
         )
         algorithm = get_dynamic_alg(self.alg, **self.alg_kwargs)
-        buckets = algorithm.solve(self.rects, ctx)
+        rows_total = sum(rc.q_range.seqlen for rc in self.rects)
+        rows_resolved = rows_total
+        incremental = False
+        buckets = None
+        if prev_state is not None:
+            from ...env.general import is_incremental_solve_enable
+
+            if is_incremental_solve_enable():
+                got = self._incremental_buckets(ctx, prev_state, algorithm)
+                if got is not None:
+                    buckets, rows_resolved = got
+                    incremental = True
+        if buckets is None:
+            buckets = algorithm.solve(self.rects, ctx)
         self.bucket_per_rank = buckets
+        self.state = DynSolveState(
+            rects=list(self.rects), buckets=buckets
+        )
 
         shard = self.meta_q.shard_seqlen
         kv_shard = self.meta_kv.shard_seqlen
@@ -323,6 +432,17 @@ class DynamicAttnSolver:
             if len(rows):
                 merge_idx[r, rows, cols] = idxs
 
+        if telemetry.enabled():
+            telemetry.record_event(
+                "plan_solve",
+                planner="dynamic",
+                event="solve",
+                incremental=incremental,
+                wall_ms=(time.perf_counter() - t0) * 1e3,
+                rows_total=rows_total,
+                rows_resolved=rows_resolved,
+                rects_total=len(self.rects),
+            )
         return DynamicAttnPlan(
             q_cast=q_cast,
             kv_cast=kv_cast,
@@ -334,6 +454,7 @@ class DynamicAttnSolver:
             q_buf_len=q_buf_len,
             k_buf_len=k_buf_len,
             ret_len=ret_len,
+            solver_state=self.state,
         )
 
 

@@ -15,6 +15,12 @@ Algorithms:
   GreedyOverlapAlg  — sweep degrees 1..max_degree, greedily pack items into
   the stage with the lowest current cost, keep the degree minimizing the
   modeled makespan (the "adaptive" part of adaptive multi-stage overlap).
+
+Two-level (dcn, ici) meshes price the slow inter-slice fabric separately:
+items carry ``dcn_rows`` (post-dedup phase-A volume), stage costs gain
+``dcn_cost``, and ``two_level_makespan`` models the DCN link as a third
+pipeline resource so stage i's DCN transfer hides under stages i-1..i's
+ICI comm + calc.
 """
 
 from __future__ import annotations
@@ -29,6 +35,9 @@ from ...config import OverlapConfig
 class OverlapStageCost:
     comm_cost: float = 0.0
     calc_cost: float = 0.0
+    # two-level plans only: the stage's DCN phase-A volume, priced
+    # separately because the inter-slice fabric is ~10x slower than ICI
+    dcn_cost: float = 0.0
 
 
 @dataclass
@@ -37,6 +46,7 @@ class OverlapItem:
 
     rows: int  # rows fetched (comm volume proxy)
     area: int  # attention area computed against these rows (calc proxy)
+    dcn_rows: int = 0  # subset of rows crossing the DCN fabric (post-dedup)
 
 
 def pipeline_makespan(costs: list[OverlapStageCost], host_calc: float) -> float:
@@ -51,6 +61,26 @@ def pipeline_makespan(costs: list[OverlapStageCost], host_calc: float) -> float:
     return span
 
 
+def two_level_makespan(costs: list[OverlapStageCost], host_calc: float) -> float:
+    """Two-fabric pipeline bound for (dcn, ici) meshes.
+
+    A stage's DCN phase-A must land before its ICI phase-B can forward, and
+    the DCN link, the ICI link, and the compute units each serve stages in
+    order — a three-resource flow shop. Stage i's DCN transfer therefore
+    hides under stages i-1..i's ICI comm + calc; only the DCN time that
+    outruns both is exposed. With all ``dcn_cost`` zero this is the same
+    schedule ``pipeline_makespan`` bounds (the DCN resource sits idle).
+    """
+    if not costs:
+        return host_calc
+    dcn_done, ici_done, calc_done = 0.0, 0.0, host_calc
+    for c in costs:
+        dcn_done += c.dcn_cost
+        ici_done = max(dcn_done, ici_done) + c.comm_cost
+        calc_done = max(ici_done, calc_done) + c.calc_cost
+    return calc_done
+
+
 class OverlapSolver:
     """Groups items into stages (ref OverlapSolver.solve :222)."""
 
@@ -63,6 +93,7 @@ class OverlapSolver:
         host_calc: float = 0.0,
         comm_per_row: float = 1.0,
         calc_per_area: float = 1.0,
+        dcn_per_row: float = 8.0,
     ) -> tuple[list[int], list[OverlapStageCost]]:
         """Returns (stage id per item, per-stage costs)."""
         if not items:
@@ -70,7 +101,8 @@ class OverlapSolver:
         cfg = self.config
         if not cfg.enable:
             return [0] * len(items), self._costs(items, [0] * len(items), 1,
-                                                 comm_per_row, calc_per_area)
+                                                 comm_per_row, calc_per_area,
+                                                 dcn_per_row)
         if cfg.degree is not None:
             degree = max(1, min(cfg.degree, len(items)))
             assign = (
@@ -79,16 +111,24 @@ class OverlapSolver:
                 else self._greedy(items, degree)
             )
             return assign, self._costs(items, assign, degree,
-                                       comm_per_row, calc_per_area)
+                                       comm_per_row, calc_per_area,
+                                       dcn_per_row)
 
-        # dynamic: sweep degrees, keep the best modeled makespan
+        # dynamic: sweep degrees, keep the best modeled makespan. Two-level
+        # items (any dcn_rows) are priced with the two-fabric flow-shop
+        # bound so a degree that pipelines DCN under ICI stages can win.
+        makespan = (
+            two_level_makespan
+            if any(it.dcn_rows for it in items)
+            else pipeline_makespan
+        )
         best = None
         max_deg = min(len(items), cfg.max_num_chunks, 8)
         for degree in range(1, max_deg + 1):
             assign = self._greedy(items, degree)
             costs = self._costs(items, assign, degree,
-                                comm_per_row, calc_per_area)
-            span = pipeline_makespan(costs, host_calc)
+                                comm_per_row, calc_per_area, dcn_per_row)
+            span = makespan(costs, host_calc)
             if best is None or span < best[0]:
                 best = (span, assign, costs)
         return best[1], best[2]
@@ -117,9 +157,11 @@ class OverlapSolver:
         return assign
 
     @staticmethod
-    def _costs(items, assign, degree, comm_per_row, calc_per_area):
+    def _costs(items, assign, degree, comm_per_row, calc_per_area,
+               dcn_per_row=8.0):
         costs = [OverlapStageCost() for _ in range(degree)]
         for it, st in zip(items, assign):
             costs[st].comm_cost += it.rows * comm_per_row
             costs[st].calc_cost += it.area * calc_per_area
+            costs[st].dcn_cost += it.dcn_rows * dcn_per_row
         return costs

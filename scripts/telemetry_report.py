@@ -274,6 +274,42 @@ def aggregate(records: list[dict]) -> dict:
             "wall_ms_max": max(walls) if walls else None,
         }
 
+    solves = kinds.get("plan_solve", [])
+    if solves:
+        solved = [r for r in solves if r.get("event") == "solve"]
+        hits = [r for r in solves if r.get("event") == "cache_hit"]
+        incr = [r for r in solved if r.get("incremental")]
+        walls = [r["wall_ms"] for r in solved if r.get("wall_ms") is not None]
+        rows_total = sum(r.get("rows_total", 0) for r in solved)
+        rows_resolved = sum(r.get("rows_resolved", 0) for r in solved)
+        inc_total = sum(r.get("rows_total", 0) for r in incr)
+        inc_resolved = sum(r.get("rows_resolved", 0) for r in incr)
+        planners: dict[str, int] = {}
+        for r in solves:
+            p = r.get("planner", "?")
+            planners[p] = planners.get(p, 0) + 1
+        agg["plan_solve"] = {
+            "events": len(solves),
+            "solves": len(solved),
+            "cache_hits": len(hits),
+            "cold": len(solved) - len(incr),
+            "incremental": len(incr),
+            "planners": dict(sorted(planners.items())),
+            "rows_total": rows_total,
+            "rows_resolved": rows_resolved,
+            "resolve_fraction": (
+                rows_resolved / rows_total if rows_total else None
+            ),
+            "incremental_resolve_fraction": (
+                inc_resolved / inc_total if inc_total else None
+            ),
+            "wall_ms_total": sum(walls) if walls else None,
+            "wall_ms_mean": sum(walls) / len(walls) if walls else None,
+            "two_level_solves": sum(
+                1 for r in solved if r.get("two_level")
+            ),
+        }
+
     hier = kinds.get("hier_plan", [])
     if hier:
         last = hier[-1]
@@ -496,6 +532,35 @@ def format_summary(agg: dict) -> str:
             lines.append(
                 f"  wall per step: mean={sv['wall_ms_mean']:.1f} ms "
                 f"max={sv['wall_ms_max']:.1f} ms"
+            )
+
+    ps = agg.get("plan_solve")
+    if ps:
+        lines.append("")
+        planners = " ".join(f"{k}={v}" for k, v in ps["planners"].items())
+        lines.append(
+            f"plan solving: solves={ps['solves']} "
+            f"(cold={ps['cold']} incremental={ps['incremental']}) "
+            f"cache_hits={ps['cache_hits']} [{planners}]"
+        )
+        if ps.get("resolve_fraction") is not None:
+            inc_s = (
+                f"; incremental-only {ps['incremental_resolve_fraction']:.1%}"
+                if ps.get("incremental_resolve_fraction") is not None
+                else ""
+            )
+            lines.append(
+                f"  rows re-solved: {ps['rows_resolved']}/{ps['rows_total']} "
+                f"({ps['resolve_fraction']:.1%} of chunk rows{inc_s})"
+            )
+        if ps.get("wall_ms_total") is not None:
+            lines.append(
+                f"  solver wall: total={ps['wall_ms_total']:.1f} ms "
+                f"mean={ps['wall_ms_mean']:.1f} ms"
+            )
+        if ps.get("two_level_solves"):
+            lines.append(
+                f"  two-level (dcn x ici) solves: {ps['two_level_solves']}"
             )
 
     hc = agg.get("hier_comm")

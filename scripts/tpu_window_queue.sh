@@ -87,6 +87,12 @@ run_queue() {
   # vs fused at 4096/8192/16384 per family -> bench_bwd.csv, each arm
   # floored at its OWN executed-matmul physics.
   run_step 1800 ".tpu_logs/${TS}_bwd_fused_ab.log" python -u bench.py --bwd-suite || return
+  # two-level (DCN x ICI) comm-plan A/B — never measured on silicon.
+  # Pre-registered expectation: post-dedup DCN rows stay <= the flat
+  # cross-node volume on every mask x mesh (dcn_ok=True in every row) and
+  # the 2x4 causal dedup ratio lands near the 3.4x the CPU plan-level
+  # suite predicts -> bench_dcn.csv.
+  run_step 900 ".tpu_logs/${TS}_dcn_suite.log" python -u bench.py --dcn-suite || return
   # GQA-packed dkv backward A/B — the prior round's tentpole measurement.
   # Pre-registered expectation: packed dkv lifts GQA
   # fwd+bwd to >= 110 TF/s reference-convention (r5 baseline 77.3 TF/s;

@@ -101,6 +101,50 @@ def _verify_static(name: str, cp: int, degree: int, verbose: bool) -> int:
     return _report(f"{name}/cp{cp}/ov{degree}", report, verbose)
 
 
+# two-level (DCN x ICI) golden corpus: mesh shapes x masks; every plan must
+# carry solver-attached hier plans and pass the R3 fabric-split sub-check
+# (phase-A + phase-B rows reconstruct the flat sends, exactly-once DCN)
+TWO_LEVEL_MESHES: tuple[tuple[int, int], ...] = ((2, 2), (2, 4), (4, 2))
+TWO_LEVEL_MASKS: tuple[str, ...] = (
+    "causal", "varlen_block_causal", "shared_prefix", "block_sparse",
+)
+
+
+def _verify_two_level(
+    name: str, mesh: tuple[int, int], degree: int, verbose: bool
+) -> int:
+    n_outer, n_inner = mesh
+    cp = n_outer * n_inner
+    qr_l, kr_l, tm = canonical_masks()[name]
+    qr = AttnRanges.from_ranges(qr_l)
+    kr = AttnRanges.from_ranges(kr_l)
+    cfg = DistAttnConfig(overlap_config=OverlapConfig(degree=degree))
+    mq, mkv, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, list(tm), SEQ, SEQ, CHUNK, cp, cfg.dispatch_config
+    )
+    cmm, calc = make_attn_meta_from_dispatch_meta(
+        bucket, mq, cfg, dispatch_meta_kv=mkv, mesh_shape=mesh
+    )
+    report = verify_plan(
+        dispatch_meta=mq,
+        bucket=bucket,
+        comm_meta=cmm,
+        calc_meta=calc,
+        global_slices=(qr, kr, list(tm), SEQ, SEQ),
+        split_alignment=cfg.grpcoll_config.split_alignment,
+    )
+    from magiattention_tpu.analysis.violation import ERROR
+
+    for st, s in enumerate(cmm.kv_stages):
+        if s.hier_plan is None:
+            report.add(
+                "R3", ERROR, f"kv_stage{st}",
+                "two-level solve produced no hier plan for this stage",
+            )
+    label = f"{name}/mesh{n_outer}x{n_inner}/ov{degree}"
+    return _report(label, report, verbose)
+
+
 def ffa_golden_plans() -> list[tuple]:
     """(label, qr, kr, d_lo, d_hi, sq, sk, blocks, gated) — direct FFA
     kernel plans (no CP solver in the loop) over fragmented sparse masks
@@ -247,6 +291,10 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--skip-dynamic", action="store_true")
     ap.add_argument(
+        "--skip-two-level", action="store_true",
+        help="skip the two-level (DCN x ICI) mesh-shape sweep",
+    )
+    ap.add_argument(
         "--skip-ffa", action="store_true",
         help="skip the direct FFA kernel-plan sweep (extents + clamp gate)",
     )
@@ -272,6 +320,16 @@ def main(argv: list[str] | None = None) -> int:
             if not args.skip_dynamic and cp > 1:
                 total_errors += _verify_dynamic(name, cp, args.verbose)
                 n_plans += 1
+    if not args.skip_two_level:
+        for name in TWO_LEVEL_MASKS:
+            if name not in masks:
+                continue
+            for mesh in TWO_LEVEL_MESHES:
+                for degree in (1, 2):
+                    total_errors += _verify_two_level(
+                        name, mesh, degree, args.verbose
+                    )
+                    n_plans += 1
     if not args.skip_ffa:
         for row in ffa_golden_plans():
             total_errors += _verify_ffa_plan(row, args.verbose)

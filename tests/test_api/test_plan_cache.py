@@ -1,0 +1,239 @@
+"""Solved-plan cache + incremental re-solve (ISSUE: plan-solve
+amortization for dynamic masks).
+
+The mask-signature-keyed ``_PlanCache`` sits one level below the
+traced-runtime LRU: a repeated signature must rebuild a manager with ZERO
+solver calls, an incrementally re-solved perturbed mask must re-run the
+assignment algorithm on a minority of rows, and both still pass the plan
+verifier identically to a cold solve."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import magiattention_tpu.dist_attn_runtime_mgr as mgr_mod
+import magiattention_tpu.meta._make_attn_meta as meta_mod
+from magiattention_tpu import telemetry
+from magiattention_tpu.analysis import verify_dynamic_plan
+from magiattention_tpu.api import init_dist_attn_runtime_key
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.config import DistAttnConfig
+from magiattention_tpu.dist_attn_runtime_mgr import (
+    _PLAN_CACHE,
+    DistAttnRuntimeMgr,
+)
+from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+from magiattention_tpu.meta._make_attn_meta import make_dynamic_attn_plan
+
+S, CHUNK = 1536, 96  # distinctive geometry: no other test shares these sigs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    _PLAN_CACHE.clear()
+    telemetry.reset()
+    yield
+    _PLAN_CACHE.clear()
+    telemetry.reset()
+
+
+def _mesh(cp=4):
+    return jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:cp]), axis_names=("cp",)
+    )
+
+
+def _key(mesh, s=S):
+    return init_dist_attn_runtime_key(
+        [[0, s]], [[0, s]], ["causal"], s, s, CHUNK, mesh=mesh
+    )
+
+
+def _count_solvers(monkeypatch):
+    """Wrap both solver entry points with call counters (the names the
+    manager module resolves at call time)."""
+    calls = {"dispatch": 0, "static": 0, "dynamic": 0}
+    real_dispatch = mgr_mod.make_dispatch_meta_from_qk_ranges
+    real_static = mgr_mod.make_attn_meta_from_dispatch_meta
+    real_dynamic = meta_mod.make_dynamic_attn_plan
+
+    def wrap(name, fn):
+        def inner(*a, **kw):
+            calls[name] += 1
+            return fn(*a, **kw)
+
+        return inner
+
+    monkeypatch.setattr(
+        mgr_mod, "make_dispatch_meta_from_qk_ranges",
+        wrap("dispatch", real_dispatch),
+    )
+    monkeypatch.setattr(
+        mgr_mod, "make_attn_meta_from_dispatch_meta",
+        wrap("static", real_static),
+    )
+    monkeypatch.setattr(
+        meta_mod, "make_dynamic_attn_plan", wrap("dynamic", real_dynamic)
+    )
+    return calls
+
+
+def test_repeat_signature_is_pure_cache_hit(monkeypatch):
+    mesh = _mesh()
+    key = _key(mesh)  # warms the runtime LRU; plan cache cleared below
+    _PLAN_CACHE.clear()
+    calls = _count_solvers(monkeypatch)
+
+    m1 = DistAttnRuntimeMgr(key, mesh)
+    assert calls == {"dispatch": 1, "static": 1, "dynamic": 0}
+
+    m2 = DistAttnRuntimeMgr(key, mesh)
+    # acceptance: repeated signature -> zero solver calls of any kind
+    assert calls == {"dispatch": 1, "static": 1, "dynamic": 0}
+    assert m2.comm_meta is m1.comm_meta
+    assert m2.calc_meta is m1.calc_meta
+    assert m2.dispatch_meta_q is m1.dispatch_meta_q
+    stats = _PLAN_CACHE.get_stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+
+
+def test_cache_disabled_resolves_every_time(monkeypatch):
+    mesh = _mesh()
+    key = _key(mesh)
+    _PLAN_CACHE.clear()
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_CACHE", "0")
+    calls = _count_solvers(monkeypatch)
+    DistAttnRuntimeMgr(key, mesh)
+    DistAttnRuntimeMgr(key, mesh)
+    assert calls["dispatch"] == 2 and calls["static"] == 2
+    assert _PLAN_CACHE.get_stats()["size"] == 0
+
+
+def test_cache_hit_still_verifies(monkeypatch, tmp_path):
+    """Acceptance: MAGI_ATTENTION_VERIFY_PLANS=1 verifies a cache-hit plan
+    identically to a cold-solved one (one plan_verify record per build)."""
+    mesh = _mesh()
+    key = _key(mesh)
+    _PLAN_CACHE.clear()
+    monkeypatch.setenv("MAGI_ATTENTION_VERIFY_PLANS", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        DistAttnRuntimeMgr(key, mesh)
+        DistAttnRuntimeMgr(key, mesh)
+    finally:
+        telemetry.reset()
+    records = []
+    for fp in sorted(tmp_path.glob("*.jsonl")):
+        with open(fp) as f:
+            records += [json.loads(ln) for ln in f if ln.strip()]
+    verifies = [r for r in records if r.get("kind") == "plan_verify"]
+    assert len(verifies) == 2
+    assert all(r["errors"] == 0 for r in verifies)
+    solves = [r for r in records if r.get("kind") == "plan_solve"]
+    events = [r["event"] for r in solves]
+    assert events.count("solve") == 1 and events.count("cache_hit") == 1
+
+
+def test_lru_eviction_respects_size(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_CACHE_SIZE", "2")
+    c = mgr_mod._PlanCache()
+    for i in range(4):
+        c.store(("sig", i), {"static": i})
+    assert c.get_stats()["size"] == 2
+    assert c.lookup(("sig", 0)) is None  # evicted oldest-first
+    assert c.lookup(("sig", 3)) is not None
+
+
+# ---------------------------------------------------------------------------
+# incremental re-solve (dynamic planner)
+# ---------------------------------------------------------------------------
+
+M = AttnMaskType
+BLOCKS = [[0, 384], [384, 768], [768, 1152], [1152, 1536]]
+
+
+def _dyn_solve(k_last_end, prev_state=None, cp=4):
+    """Varlen block-causal mask; the last block's k extent is the knob a
+    'new decode step' turns while the first three blocks stay fixed."""
+    qr = AttnRanges.from_ranges(BLOCKS)
+    kr = AttnRanges.from_ranges(BLOCKS[:3] + [[1152, k_last_end]])
+    tm = [M.CAUSAL] * 4
+    cfg = DistAttnConfig()
+    mq, mkv, _ = make_dispatch_meta_from_qk_ranges(
+        qr, kr, tm, S, S, CHUNK, cp, cfg.dispatch_config
+    )
+    return make_dynamic_attn_plan(
+        qr, kr, tm, mq, cfg, dispatch_meta_kv=mkv, prev_state=prev_state
+    )
+
+
+def test_incremental_resolve_minority_of_rows(monkeypatch, tmp_path):
+    """Acceptance: a perturbed mask re-solves < 50% of chunk rows, and the
+    incremental plan passes the verifier like a cold one."""
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        plan1 = _dyn_solve(1536)
+        assert plan1.solver_state is not None
+        plan2 = _dyn_solve(1440, prev_state=plan1.solver_state)
+    finally:
+        telemetry.reset()
+    records = []
+    for fp in sorted(tmp_path.glob("*.jsonl")):
+        with open(fp) as f:
+            records += [json.loads(ln) for ln in f if ln.strip()]
+    solves = [
+        r for r in records
+        if r.get("kind") == "plan_solve" and r["planner"] == "dynamic"
+    ]
+    assert len(solves) == 2
+    cold, inc = solves
+    assert cold["incremental"] is False
+    assert inc["incremental"] is True
+    assert inc["rows_resolved"] < 0.5 * inc["rows_total"]
+    # the incremental plan is verified exactly like a cold one
+    for plan in (plan1, plan2):
+        report = verify_dynamic_plan(plan)
+        assert not report.errors(), [str(v) for v in report.errors()]
+
+
+def test_incremental_matches_mask_exactly():
+    """The incrementally patched bucket set must cover exactly the new
+    mask: solve cold and incrementally, compare total assigned area."""
+    plan_cold = _dyn_solve(1440)
+    plan1 = _dyn_solve(1536)
+    plan_inc = _dyn_solve(1440, prev_state=plan1.solver_state)
+    # identical global work: per-rank areas may differ (different but
+    # equally valid assignment), the sum may not
+    def total_area(plan):
+        return sum(int(a.area()) for a in plan.attn_args)
+
+    assert total_area(plan_inc) == total_area(plan_cold)
+
+
+def test_incremental_disabled_falls_back_to_cold(monkeypatch, tmp_path):
+    monkeypatch.setenv("MAGI_ATTENTION_INCREMENTAL_SOLVE", "0")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        plan1 = _dyn_solve(1536)
+        _dyn_solve(1440, prev_state=plan1.solver_state)
+    finally:
+        telemetry.reset()
+    records = []
+    for fp in sorted(tmp_path.glob("*.jsonl")):
+        with open(fp) as f:
+            records += [json.loads(ln) for ln in f if ln.strip()]
+    solves = [
+        r for r in records
+        if r.get("kind") == "plan_solve" and r["planner"] == "dynamic"
+    ]
+    assert [r["incremental"] for r in solves] == [False, False]
+    assert all(r["rows_resolved"] == r["rows_total"] for r in solves)

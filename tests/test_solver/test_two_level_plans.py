@@ -1,0 +1,170 @@
+"""Two-level (DCN x ICI) planning: the three-resource makespan model, the
+solver-attached hierarchical stage plans, and the DCN dedup guarantee
+(ISSUE: two-level comm plans with DCN-under-ICI overlap)."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu.common.enum import AttnMaskType
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.config import DistAttnConfig, OverlapConfig
+from magiattention_tpu.meta import (
+    make_attn_meta_from_dispatch_meta,
+    make_dispatch_meta_from_qk_ranges,
+)
+from magiattention_tpu.meta.solver.overlap_solver import (
+    OverlapStageCost,
+    pipeline_makespan,
+    two_level_makespan,
+)
+
+SEQ, CHUNK = 2048, 128
+
+
+def _solve(mask="causal", cp=8, mesh_shape=(2, 4), degree=2):
+    M = AttnMaskType
+    masks = {
+        "causal": ([[0, SEQ]], [[0, SEQ]], [M.CAUSAL]),
+        "shared_prefix": (
+            [[0, SEQ], [256, SEQ]], [[0, 256], [256, SEQ]],
+            [M.FULL, M.CAUSAL],
+        ),
+    }
+    qr_l, kr_l, tm = masks[mask]
+    qr = AttnRanges.from_ranges(qr_l)
+    kr = AttnRanges.from_ranges(kr_l)
+    cfg = DistAttnConfig(overlap_config=OverlapConfig(degree=degree))
+    mq, mkv, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, list(tm), SEQ, SEQ, CHUNK, cp, cfg.dispatch_config
+    )
+    cmm, calc = make_attn_meta_from_dispatch_meta(
+        bucket, mq, cfg, dispatch_meta_kv=mkv, mesh_shape=mesh_shape
+    )
+    kv_ranges = cmm.kv_host_ranges or mkv.host_ranges_per_rank
+    return cmm, calc, kv_ranges
+
+
+# ---------------------------------------------------------------------------
+# makespan model
+# ---------------------------------------------------------------------------
+
+
+def test_two_level_reduces_to_pipeline_without_dcn():
+    costs = [
+        OverlapStageCost(comm_cost=3.0, calc_cost=2.0),
+        OverlapStageCost(comm_cost=1.0, calc_cost=4.0),
+        OverlapStageCost(comm_cost=2.0, calc_cost=1.0),
+    ]
+    for host_calc in (0.0, 2.5, 10.0):
+        assert two_level_makespan(costs, host_calc) == pytest.approx(
+            pipeline_makespan(costs, host_calc)
+        )
+
+
+def test_two_level_makespan_hand_case():
+    # stage0: dcn 2 -> ici 1 -> calc 1;  stage1: dcn 4 lands at t=6, its
+    # ici (2) starts then, calc (1) after -> 9
+    costs = [
+        OverlapStageCost(comm_cost=1.0, calc_cost=1.0, dcn_cost=2.0),
+        OverlapStageCost(comm_cost=2.0, calc_cost=1.0, dcn_cost=4.0),
+    ]
+    assert two_level_makespan(costs, host_calc=0.5) == pytest.approx(9.0)
+    # flat model would ignore the DCN serialization entirely
+    assert pipeline_makespan(costs, 0.5) < two_level_makespan(costs, 0.5)
+
+
+def test_two_level_makespan_monotone_in_dcn():
+    base = [OverlapStageCost(comm_cost=1.0, calc_cost=1.0, dcn_cost=d)
+            for d in (0.0, 0.0)]
+    prev = two_level_makespan(base, 1.0)
+    for scale in (1.0, 2.0, 5.0):
+        cur = two_level_makespan(
+            [OverlapStageCost(1.0, 1.0, dcn_cost=scale)] * 2, 1.0
+        )
+        assert cur >= prev
+        prev = cur
+
+
+def test_empty_and_single_stage():
+    assert two_level_makespan([], 3.0) == 3.0
+    one = [OverlapStageCost(comm_cost=2.0, calc_cost=1.0, dcn_cost=4.0)]
+    # dcn 4 -> ici done 6 -> calc max(6, host) + 1
+    assert two_level_makespan(one, 1.0) == pytest.approx(7.0)
+    assert two_level_makespan(one, 10.0) == pytest.approx(11.0)
+
+
+# ---------------------------------------------------------------------------
+# solver-attached hier plans
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2)])
+def test_solver_attached_plan_matches_runtime_replan(mesh_shape):
+    """The plans the solver attaches must be byte-identical to what the
+    runtime's own re-plan fallback would build — same function, same
+    arguments — so consuming them skips work without changing execution."""
+    from magiattention_tpu.comm.hier import make_hier_group_cast_plan
+
+    cmm, calc, kv_ranges = _solve(mesh_shape=mesh_shape)
+    n_outer, n_inner = mesh_shape
+    assert cmm.kv_stages, "no stages solved"
+    for s in cmm.kv_stages:
+        plan = s.hier_plan
+        assert plan is not None
+        assert (plan.n_outer, plan.n_inner) == mesh_shape
+        fresh = make_hier_group_cast_plan(
+            s.transfer_table, kv_ranges, n_outer, n_inner,
+            alignment=128, r_max=s.r_max, shard_len=calc.kv_shard_len,
+        )
+        for f in ("a_send_idx", "a_recv_sel", "a_recv_len",
+                  "b_send_idx", "b_recv_sel"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(plan, f)), np.asarray(getattr(fresh, f)),
+                err_msg=f,
+            )
+
+
+def test_flat_solve_attaches_no_hier_plan():
+    cmm, _, _ = _solve(mesh_shape=None)
+    assert all(s.hier_plan is None for s in cmm.kv_stages)
+
+
+@pytest.mark.parametrize("mask", ["causal", "shared_prefix"])
+def test_dcn_rows_within_flat_prediction(mask):
+    """Acceptance: the two-level plan's DCN rows never exceed the flat
+    plan's cross-node rows — the dedup ratio prediction holds."""
+    cmm, _, _ = _solve(mask=mask, mesh_shape=(2, 4))
+    n_inner = 4
+    for s in cmm.kv_stages:
+        flat_dcn = sum(
+            s.transfer_table[d][src].total_seqlen
+            for d in range(len(s.transfer_table))
+            for src in range(len(s.transfer_table))
+            if d // n_inner != src // n_inner
+        )
+        assert s.hier_plan.dcn_rows() <= flat_dcn
+        assert "dcn_rows" in s.telemetry_dict()
+
+
+def test_stage_costs_price_dcn_rows():
+    """OverlapItem.dcn_rows must reach the stage cost model: pricing DCN
+    rows changes the computed makespan for a cross-node-heavy layout."""
+    from magiattention_tpu.meta.solver.overlap_solver import (
+        OverlapItem,
+        OverlapSolver,
+    )
+
+    items = [
+        OverlapItem(rows=128, area=1 << 14, dcn_rows=128),
+        OverlapItem(rows=128, area=1 << 14, dcn_rows=0),
+    ]
+    assign = [0, 0]
+    cheap = OverlapSolver._costs(items, assign, 1, 1.0, 1.0, dcn_per_row=0.0)
+    steep = OverlapSolver._costs(
+        items, assign, 1, 1.0, 1.0, dcn_per_row=100.0
+    )
+    assert cheap[0].dcn_cost == 0.0
+    assert steep[0].dcn_cost == pytest.approx(128 * 100.0)
+    # ici/calc costs unaffected by the dcn price
+    assert cheap[0].comm_cost == steep[0].comm_cost
+    assert cheap[0].calc_cost == steep[0].calc_cost
