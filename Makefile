@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
-	telemetry-smoke analysis lint verify-plans kernel-audit chaos serve-smoke
+	telemetry-smoke analysis lint verify-plans kernel-audit chaos \
+	serve-smoke perf-gate
 
 test: analysis chaos serve-smoke  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -42,8 +43,14 @@ queue:  ## background chip-window experiment poller
 fit-overhead:  ## fit tile_policy.OVERHEAD_ELEMS from recorded sweeps
 	$(PY) scripts/fit_tile_overhead.py
 
-telemetry-smoke:  ## CPU single-step telemetry round trip (JSONL -> report)
-	$(PY) -m pytest tests/test_support/test_telemetry.py -x -q
+telemetry-smoke:  ## CPU telemetry round trip: JSONL + store + registry -> report, then the perf gate
+	$(PY) -m pytest tests/test_support/test_telemetry.py \
+		tests/test_support/test_store.py \
+		tests/test_support/test_registry.py -x -q
+	$(PY) scripts/perf_gate.py
+
+perf-gate:  ## fail on >10% bench regression vs prior run without a BENCH note
+	$(PY) scripts/perf_gate.py
 
 chaos:  ## fault-injection chaos matrix: every site recovers or raises typed
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience -x -q -m chaos

@@ -63,8 +63,9 @@ def is_telemetry_enable() -> bool:
 
 
 def telemetry_dir() -> str:
-    """Directory for telemetry JSONL files (one per process,
-    ``magiattention-<pid>.jsonl``); read by telemetry/registry.py."""
+    """Directory for telemetry JSONL files (one per writer,
+    ``magiattention-<host>-<pid>-<token>.jsonl``); read by
+    telemetry/registry.py."""
     return _get_str("MAGI_ATTENTION_TELEMETRY_DIR", "telemetry")
 
 
@@ -197,6 +198,14 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     "MAGI_ATTENTION_FFA_MIXED_BLOCKS",
     # fused vs split backward changes which kernels the vjp traces
     "MAGI_ATTENTION_FFA_FUSED_BWD",
+    # registry pins (env/backend.py) select traced kernels directly, and the
+    # persistent store / calibration gates let measured history steer both
+    # kernel choice and solver constants — cached runtimes must not be
+    # shared across flips of any of them
+    "MAGI_ATTENTION_BACKEND_FFA_BWD",
+    "MAGI_ATTENTION_BACKEND_MIXED_BLOCKS",
+    "MAGI_ATTENTION_BACKEND_STORE",
+    "MAGI_ATTENTION_CALIBRATION",
     # wire-tier selection changes the traced collective program
     "MAGI_ATTENTION_RAGGED_GRPCOLL",
     "MAGI_ATTENTION_SPLIT_ALIGNMENT",

@@ -71,28 +71,6 @@ def ffa_extent_clamp() -> bool:
     return _get_int("MAGI_ATTENTION_FFA_EXTENT_CLAMP", 1) == 1
 
 
-def ffa_mixed_blocks() -> str:
-    """Mixed-granularity block dispatch: 'auto' (split the slice set into a
-    coarse-block dense pass and a fine-block fragmented pass when the plan
-    cost model says the split + LSE merge wins), '1' (split whenever a
-    non-trivial partition exists), '0' (never). Fragmentation is judged by
-    the per-slice padded/band cover ratio (tile_policy.slice_cover_ratios);
-    the two passes are merged through the standard LSE-merge math."""
-    return _get_str("MAGI_ATTENTION_FFA_MIXED_BLOCKS", "auto").lower()
-
-
-def ffa_fused_bwd() -> str:
-    """Fused one-pass FFA backward: 'auto' (the tile_policy cost model
-    picks fused vs split per band shape / dtype / group, under the fused
-    VMEM residency guard), '1' (fused whenever feasible — the VMEM guard
-    and the plan's q-visit meta columns still gate it), '0' (always the
-    split dq + dkv passes). The fused kernel recomputes scores ONCE per
-    work item for dq, dk AND dv — 5 tile matmuls where split spends 7 —
-    accumulating dq by revisiting its output block across the k-major
-    traversal (see docs/backward_fusion.md)."""
-    return _get_str("MAGI_ATTENTION_FFA_FUSED_BWD", "auto").lower()
-
-
 def ffa_gqa_pack_dq() -> bool:
     """GQA-pack the dq backward kernel (grid (hk, W)): k/v fetched once
     per work item instead of per q-head, s/dp matmuls g x taller,

@@ -9,17 +9,7 @@ kernel layer.
 
 from __future__ import annotations
 
-from .general import _get_int, _get_str
-
-
-def serve_decode_kernel() -> str:
-    """Decode-attention rung selection for serving/decode.py:
-    ``auto`` (default) — start at the Pallas paged-decode kernel and let
-    the fallback ladder descend on failure; ``1`` — same start, kept for
-    symmetry with the ffa tri-states; ``0`` — pin the gather+FFA reference
-    rung (the serve-smoke bitwise-equality configuration)."""
-    val = _get_str("MAGI_ATTENTION_SERVE_DECODE_KERNEL", "auto").lower()
-    return val if val in ("auto", "1", "0") else "auto"
+from .general import _get_int
 
 
 def serve_max_slots() -> int:

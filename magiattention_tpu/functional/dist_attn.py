@@ -24,6 +24,7 @@ serves every rank — the TPU answer to the reference's per-rank host code.
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 from functools import partial
 
@@ -36,11 +37,12 @@ from ..utils.compat import shard_map
 from ..comm.primitives import cast_rows, reduce_rows
 from ..env import comm as env_comm
 from ..env import general as env_general
-from ..env import kernel as env_kernel
 from ..env import resilience as env_resilience
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
+    bwd_mode_key,
+    bwd_modeled_cost,
     ffa_bwd_pallas_dispatch,
     ffa_delta_pallas_dispatch,
     ffa_fwd_pallas_dispatch,
@@ -350,6 +352,8 @@ class DeferredTilePolicy:
     """
 
     def _init_tile_policy(self, block_q, block_k) -> None:
+        from ..kernels import registry as kernel_registry
+
         self._plan_sig = None
         self._auto_tile_pending = False
         # set by the resilience ladder when the FFA path is abandoned for
@@ -358,9 +362,12 @@ class DeferredTilePolicy:
         # per-pass picks from the auto-tile policy, consumed by the
         # subclasses' _build_plans via _stack_plans (env overrides win)
         self._policy_bwd: tuple = (None, None)
+        # telemetry signatures (computed lazily; mask sig is plan-stable)
+        self._tel_mask_sig: str | None = None
+        self._tel_env_sig: tuple | None = None
         if (
             block_q is None and block_k is None
-            and not env_kernel.ffa_blocks_pinned()
+            and not kernel_registry.tiles_pinned()
         ):
             from ..kernels.tile_policy import auto_tile_enabled
 
@@ -397,6 +404,64 @@ class DeferredTilePolicy:
         self._policy_bwd = (pol_dq, pol_dkv)
         self._build_plans(blk_q, blk_k)
         self._plan_sig = sig
+
+    # -- observatory signatures (telemetry/store.py join keys) ----------
+
+    def _policy_key(self) -> dict:
+        """The calc_attn registry/measurement key: mask-class signature x
+        mesh x env snapshot. Keyed exactly like store.ingest_event's
+        calc_attn measurement rows, so the registry's measured-history
+        lookup joins against this runtime's own recorded steps."""
+        return {
+            "mask_sig": self._mask_signature(),
+            "mesh_sig": self._mesh_signature(),
+            "env_sig": self._env_signature(),
+        }
+
+    def _mask_signature(self) -> str:
+        """Digest of the mask-class geometry (slice arrays + shard lens);
+        plan-stable, so computed once per runtime."""
+        sig = self._tel_mask_sig
+        if sig is None:
+            geoms, sq, sk = self._tile_geoms()
+            h = hashlib.md5(repr((sq, sk, len(geoms))).encode())
+            for g in geoms:
+                for a in g:
+                    h.update(np.ascontiguousarray(a).tobytes())
+            sig = h.hexdigest()[:16]
+            self._tel_mask_sig = sig
+        return sig
+
+    def _mesh_signature(self) -> str:
+        return repr((
+            tuple(sorted(self.mesh.shape.items())),
+            self.cp_axis,
+            getattr(self, "head_axis", None),
+        ))
+
+    def _env_signature(self) -> str:
+        """Digest of the behavior-affecting env snapshot (memoized per
+        snapshot value — flips mid-life re-key the policy lookups)."""
+        snap = env_general.snapshot_env()
+        cached = self._tel_env_sig
+        if cached is not None and cached[0] == snap:
+            return cached[1]
+        sig = hashlib.md5(repr(snap).encode()).hexdigest()[:16]
+        self._tel_env_sig = (snap, sig)
+        return sig
+
+    @property
+    def backend(self) -> str:
+        """Kernel backend via the registry's ``calc_attn`` decision: an
+        explicit MAGI_ATTENTION_KERNEL_BACKEND pins it, otherwise the
+        policy cache / measured history / the 'ffa' default decide. A
+        resilience-ladder override (sticky degradation to the reference
+        path) wins over everything."""
+        if self._backend_override is not None:
+            return self._backend_override
+        from ..kernels import registry as kernel_registry
+
+        return kernel_registry.calc_attn_backend(self._policy_key())
 
 
 @dataclass(eq=False)
@@ -611,6 +676,12 @@ class DistAttnRuntime(DeferredTilePolicy):
             stages.append(d)
         payload = {
             "backend": self.backend,
+            # observatory join keys (telemetry/store.py _ATTN_KEY_FIELDS)
+            "mask_sig": self._mask_signature(),
+            "mesh_sig": self._mesh_signature(),
+            "env_sig": self._env_signature(),
+            "q_shape": list(q.shape),
+            "kv_shape": list(v.shape),
             "cp_size": self.cp_size,
             "overlap_degree": self.num_stages,
             "use_overlap": self.use_overlap,
@@ -635,6 +706,10 @@ class DistAttnRuntime(DeferredTilePolicy):
             # representative (host/merged) plan dims
             dims0 = self._host_dims if self.use_overlap else self._merged_dims
             prm0 = self._ffa_params(dims0, 1.0, hq // hk)
+            bwd_mode = resolved_bwd_mode(
+                prm0, prm0.num_q_tiles * prm0.block_q, dh, dv,
+                q.dtype.itemsize,
+            )
             payload.update(
                 block_q=self._bq, block_k=self._bk,
                 plan_groups=self._tel_plan_groups,
@@ -643,9 +718,15 @@ class DistAttnRuntime(DeferredTilePolicy):
                 # fwd FLOPs, FlashAttention-2 convention (perf_report.py)
                 est_flops_fwd=4 * band * dh * hq,
                 padded_flops_fwd=4 * padded * dh * hq,
-                bwd_mode=resolved_bwd_mode(
-                    prm0, prm0.num_q_tiles * prm0.block_q, dh, dv,
-                    q.dtype.itemsize,
+                bwd_mode=bwd_mode,
+                # the mode decision's registry/store key + modeled cost, so
+                # the drift layer can compare choose_bwd_mode's prediction
+                # against this step's measured wall time
+                bwd_key=list(
+                    bwd_mode_key(prm0, dh, dv, q.dtype.itemsize)
+                ),
+                bwd_cost=bwd_modeled_cost(
+                    prm0, dh, dv, q.dtype.itemsize, bwd_mode
                 ),
             )
         return payload
@@ -719,13 +800,6 @@ class DistAttnRuntime(DeferredTilePolicy):
                 v, opsl, kinds, self._axis(), v.shape[0], v.dtype.name
             )
             return list(kp), list(vp)
-
-    @property
-    def backend(self) -> str:
-        """Kernel backend (env-driven; part of the runtime cache key).
-        A resilience-ladder override (sticky degradation to the reference
-        path) wins over the env choice."""
-        return self._backend_override or env_general.kernel_backend()
 
     # ------------------------------------------------------------------
 

@@ -32,11 +32,12 @@ from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm.primitives import cast_rows, reduce_rows
-from ..env import general as env_general
 from ..env import resilience as env_resilience
 from ..kernels.ffa import (
     FFAParams,
     _bwd_plan_slices,
+    bwd_mode_key,
+    bwd_modeled_cost,
     ffa_bwd_pallas_dispatch,
     ffa_delta_pallas_dispatch,
     _should_interpret,
@@ -284,6 +285,12 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
         payload = {
             "planner": "dynamic",
             "backend": self.backend,
+            # observatory join keys (telemetry/store.py _ATTN_KEY_FIELDS)
+            "mask_sig": self._mask_signature(),
+            "mesh_sig": self._mesh_signature(),
+            "env_sig": self._env_signature(),
+            "q_shape": list(q.shape),
+            "kv_shape": list(v.shape),
             "cp_size": self.mesh.shape[self.cp_axis],
             "overlap_degree": 1,  # qo-comm runs one compute stage
             "seqlen_q_shard": sq,
@@ -311,14 +318,21 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
                 **overrides, softmax_scale=1.0, softcap=self.softcap,
                 group=hq // hk, interpret=_should_interpret(),
             )
+            bwd_mode = resolved_bwd_mode(
+                prm0, nqt * self._bq, dh, dv, q.dtype.itemsize
+            )
             payload.update(
                 block_q=self._bq, block_k=self._bk,
                 band_elems=band,
                 padded_elems=padded,
                 est_flops_fwd=4 * band * dh * hq,
                 padded_flops_fwd=4 * padded * dh * hq,
-                bwd_mode=resolved_bwd_mode(
-                    prm0, nqt * self._bq, dh, dv, q.dtype.itemsize
+                bwd_mode=bwd_mode,
+                bwd_key=list(
+                    bwd_mode_key(prm0, dh, dv, q.dtype.itemsize)
+                ),
+                bwd_cost=bwd_modeled_cost(
+                    prm0, dh, dv, q.dtype.itemsize, bwd_mode
                 ),
             )
         return payload
@@ -333,12 +347,6 @@ class DynamicDistAttnRuntime(DeferredTilePolicy):
             p.q_buf_len,
             p.k_buf_len,
         )
-
-    @property
-    def backend(self) -> str:
-        # a resilience-ladder override (sticky degradation to the
-        # reference path) wins over the env choice
-        return self._backend_override or env_general.kernel_backend()
 
     @instrument_scope(name="DynamicDistAttnRuntime.calc_attn")
     def calc_attn(

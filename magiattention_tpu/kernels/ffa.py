@@ -78,6 +78,14 @@ from .ffa_plan import (  # noqa: F401
 from .mask_utils import types_to_bands
 
 NEG_INF = float("-inf")
+
+
+def _registry_mod():
+    """Lazy handle on the backend registry (kernels/registry.py) — every
+    kernel-choice read in this file flows through it, not raw env flags."""
+    from . import registry as _registry
+
+    return _registry
 NUM_LANES = 128
 NUM_SUBLANES = 8
 # jax < 0.5 exposes the TPU compiler params as TPUCompilerParams; newer
@@ -217,7 +225,9 @@ def _clamp_chunks(width: int) -> int:
     single-dot bodies lower unchanged). Chunk width must stay a lane-quantum
     multiple (``_lane_tile``/Mosaic layout rule), so the count is the
     largest divisor of ``width // NUM_LANES`` within the chunk cap."""
-    if not env_kernel.ffa_extent_clamp() or width % NUM_LANES:
+    from . import registry as _registry
+
+    if not _registry.extent_clamp_enabled() or width % NUM_LANES:
         return 0
     m = width // NUM_LANES
     return max(c for c in range(1, min(_MAX_CLAMP_CHUNKS, m) + 1) if m % c == 0)
@@ -713,8 +723,10 @@ def _use_gqa_pack(
     score-tile intermediates, utils/mem_budget.ffa_kernel_residency — the
     same model the static kernel checker proves K1 with) must fit the
     per-core budget with headroom."""
+    from . import registry as _registry
+
     return (
-        env_kernel.ffa_gqa_pack()
+        _registry.gqa_pack_variant("fwd") == "gqa_packed"
         and params.group > 1
         and not params.emit_max_logits
         and ffa_kernel_residency(
@@ -1156,9 +1168,11 @@ def _use_gqa_pack_dq(
     REAL head dims (utils/mem_budget.ffa_kernel_residency — shared with
     the static kernel checker's K1; an earlier score-tile-only formula
     under-counted blocks + scratch at large head_dim)."""
+    from . import registry as _registry
+
     bq, bk = params.dq_blocks()
     return (
-        env_kernel.ffa_gqa_pack_dq()
+        _registry.gqa_pack_variant("dq") == "gqa_packed"
         and params.group > 1
         and ffa_kernel_residency(
             "dq", bq, bk, d, head_dim_v=dv, dtype_bytes=itemsize,
@@ -1638,7 +1652,7 @@ def _ffa_bwd_dkv_pallas_gqa(
     )
     kernel = partial(
         _bwd_dkv_kernel_gqa, softcap=params.softcap, bq=bq, bk=bk, g=g,
-        clamp=env_kernel.ffa_extent_clamp(),
+        clamp=_registry_mod().extent_clamp_enabled(),
     )
     dk_t, dv_t = pl.pallas_call(
         kernel,
@@ -1667,9 +1681,11 @@ def _use_gqa_pack_dkv(
     blocks + (bk, d+dv) fp32 scratch + the (bk, g*bq) fp32 s_t/dp_t tiles
     (utils/mem_budget.ffa_kernel_residency, shared with the static kernel
     checker's K1) — must fit the per-core budget with headroom."""
+    from . import registry as _registry
+
     bq, bk = params.dkv_blocks()
     return (
-        env_kernel.ffa_gqa_pack_dkv()
+        _registry.gqa_pack_variant("dkv") == "gqa_packed"
         and params.group > 1
         and sqp % bq == 0
         and ffa_kernel_residency(
@@ -2291,7 +2307,7 @@ def _ffa_bwd_fused_pallas_gqa(
     kernel = partial(
         _bwd_fused_kernel_gqa, softcap=params.softcap,
         scale=params.softmax_scale, bq=bq, bk=bk, g=g,
-        clamp=env_kernel.ffa_extent_clamp(),
+        clamp=_registry_mod().extent_clamp_enabled(),
     )
     dq_g, dk_t, dv_t = pl.pallas_call(
         kernel,
@@ -2322,9 +2338,11 @@ def _use_gqa_pack_fused(
     identical) with the LARGER fused residency — dkv's plus the revisited
     dq window and its aliased zero background (utils/mem_budget
     ``ffa_kernel_residency("fused", ...)``, one source of truth with K1)."""
+    from . import registry as _registry
+
     bq, bk = params.dkv_blocks()
     return (
-        env_kernel.ffa_gqa_pack_dkv()
+        _registry.gqa_pack_variant("dkv") == "gqa_packed"
         and params.group > 1
         and sqp % bq == 0
         and ffa_kernel_residency(
@@ -2377,12 +2395,18 @@ def ffa_bwd_mode(
     at trace time (static work counts / blocks / dims only; no plan
     contents, which may be traced arrays under shard_map).
 
-    MAGI_ATTENTION_FFA_FUSED_BWD: "0" always split; "1" fused whenever
-    feasible (VMEM + plan meta carries the q-visit flag columns); "auto"
-    (default) lets the tile_policy cost model pick per geometry.
+    Selection flows through the backend registry (kernels/registry.py):
+    a 'split'/'fused' pin (MAGI_ATTENTION_BACKEND_FFA_BWD, or the legacy
+    MAGI_ATTENTION_FFA_FUSED_BWD mapped 0/1) wins outright — 'fused' still
+    subject to the feasibility guards below — and unpinned geometries
+    resolve against the policy cache / measured history, falling back to
+    the tile_policy cost model.
     """
-    flag = env_kernel.ffa_fused_bwd()
-    if flag == "0":
+    from ..env import backend as env_backend
+    from . import registry as _registry
+
+    pin = env_backend.ffa_bwd_pin()
+    if pin == "split":
         return "split"
     if meta_cols <= QVL:
         # plan meta predates the QVF/QVL visit-flag columns (hand-built
@@ -2390,10 +2414,27 @@ def ffa_bwd_mode(
         return "split"
     if not fused_bwd_feasible(params, sqp, d, dv, itemsize):
         return "split"
-    if flag == "1":
+    if pin == "fused":
         return "fused"
     from .tile_policy import choose_bwd_mode
 
+    key = bwd_mode_key(params, d, dv, itemsize)
+    return _registry.resolve(
+        "ffa_bwd",
+        key,
+        lambda: choose_bwd_mode(
+            *key[:7], dv, itemsize=itemsize, group=params.group
+        ),
+    ).name
+
+
+def bwd_mode_key(
+    params: FFAParams, d: int, dv: int, itemsize: int
+) -> tuple[int, ...]:
+    """The registry/store key of one backward-mode decision: the exact
+    static quantities choose_bwd_mode consumes — (w_dq, bq_dq, bk_dq, wt,
+    bq_dkv, bk_dkv, d, dv, itemsize, group). Shared by ffa_bwd_mode and
+    the telemetry layer so measured history joins against resolutions."""
     bq_dq, bk_dq = params.dq_blocks()
     bq_dkv, bk_dkv = params.dkv_blocks()
     w_dq = (
@@ -2406,9 +2447,30 @@ def ffa_bwd_mode(
         if params.num_work_dkv is not None
         else params.num_work_t
     )
-    return choose_bwd_mode(
-        w_dq, bq_dq, bk_dq, wt, bq_dkv, bk_dkv, d, dv,
-        itemsize=itemsize, group=params.group,
+    return (
+        w_dq, bq_dq, bk_dq, wt, bq_dkv, bk_dkv, d, dv, itemsize,
+        params.group,
+    )
+
+
+def bwd_modeled_cost(
+    params: FFAParams, d: int, dv: int, itemsize: int, mode: str
+) -> int:
+    """choose_bwd_mode's modeled cost (MXU elems + balanced HBM term) of
+    running the backward under ``mode`` — what the drift layer compares
+    against measured wall time."""
+    from .tile_policy import (
+        BWD_MXU_ELEMS_PER_HBM_BYTE,
+        bwd_hbm_bytes,
+        bwd_mxu_elems,
+    )
+
+    key = bwd_mode_key(params, d, dv, itemsize)
+    args = key[:7]
+    return bwd_mxu_elems(mode, *args) + BWD_MXU_ELEMS_PER_HBM_BYTE * (
+        bwd_hbm_bytes(
+            mode, *args, dv, itemsize=itemsize, group=params.group
+        )
     )
 
 
@@ -3004,7 +3066,7 @@ def ffa_attn(
         not return_max_logits
         and block_q is None
         and block_k is None
-        and not env_kernel.ffa_blocks_pinned()
+        and not _registry_mod().tiles_pinned()
     ):
         # mixed-granularity dispatch: when the cost model (or an explicit
         # MAGI_ATTENTION_FFA_MIXED_BLOCKS=1) says a coarse/fine split wins,
@@ -3038,7 +3100,7 @@ def ffa_attn(
                 ),
             )
     policy_dq = policy_dkv = None
-    if block_q is None and block_k is None and not env_kernel.ffa_blocks_pinned():
+    if block_q is None and block_k is None and not _registry_mod().tiles_pinned():
         from .tile_policy import auto_tile_enabled, choose_blocks_per_pass
 
         if auto_tile_enabled():

@@ -1,0 +1,362 @@
+"""Unified attention-backend registry: ONE selection point for every
+kernel-choice decision in the package.
+
+Call sites that used to read env flags directly (``ffa_bwd_mode``'s
+``MAGI_ATTENTION_FFA_FUSED_BWD``, ``choose_mixed_dispatch``'s
+``MAGI_ATTENTION_FFA_MIXED_BLOCKS``, ``decode_attn_step``'s
+``MAGI_ATTENTION_SERVE_DECODE_KERNEL``, ``DistAttnRuntime.backend``'s
+``MAGI_ATTENTION_KERNEL_BACKEND``) now resolve through
+:func:`resolve`, with precedence:
+
+1. **pin** — an explicit env-derived choice (env/backend.py getters map
+   both the new ``MAGI_ATTENTION_BACKEND_*`` keys and the legacy flags to
+   pins). A pin bypasses every cache, is re-read per call (tests flip env
+   vars mid-process), and is subject only to the call site's *feasibility*
+   guards (VMEM, plan meta layout) — exactly the legacy flag semantics.
+2. **cached decision** — the in-process memo, then the persistent policy
+   store (telemetry/store.py): a prior resolution persisted across
+   restarts, or the fastest backend with enough ``ok`` measurements in
+   history (``measured``). Both are gated on ``store_active()`` at *use*
+   time, so flipping telemetry off mid-process also stops store-sourced
+   decisions from applying — with the observatory off, resolution is
+   bit-identical to the legacy heuristics.
+3. **heuristic** — the call site's legacy default (cost model or constant),
+   run at most once per key (memoized + persisted when the store is on).
+   Each heuristic run counts as one *tuning decision*
+   (``stats()["heuristic_calls"]``); a warm policy cache makes zero.
+
+Rank-ordered backend registrations double as the resilience ladders:
+``ladder("serve_decode")`` is the decode fallback order and
+``ladder("calc_attn")[-1]`` is the reference rung the resilience module
+descends to (resilience/fallback.py).
+
+MAGI-L002: no clocks here — measurements enter via the telemetry store,
+never from this module. MAGI-L001: env access only through typed getters.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from .. import telemetry
+from ..env import backend as env_backend
+from ..env import kernel as env_kernel
+
+# sources a resolution can come from; STORE_SOURCES only apply while the
+# store is active (checked on every memo hit, so a stale store-sourced memo
+# can never leak into a telemetry-off run)
+STORE_SOURCES = ("policy", "measured")
+
+
+@dataclass(frozen=True)
+class BackendChoice:
+    name: str
+    source: str  # "pin" | "policy" | "measured" | "heuristic"
+
+
+def _memo_key(key: Any) -> Any:
+    """Hashable form of a decision key. Dict keys (the calc_attn policy
+    key) canonicalize to their sorted-JSON string; the ORIGINAL key is
+    still what store lookups join on, so the on-disk form matches what
+    ingest_event writes."""
+    try:
+        hash(key)
+        return key
+    except TypeError:
+        from ..telemetry.store import canonical_key
+
+        return canonical_key(key)
+
+
+# decision -> [(rank, name, description)], rank order = ladder order
+_BACKENDS: dict[str, list[tuple[int, str, str]]] = {}
+
+
+def register_backend(
+    decision: str, name: str, rank: int, description: str = ""
+) -> None:
+    """Register a backend for a decision. Rank orders the fallback ladder
+    (0 = preferred / fastest, last = most conservative reference)."""
+    entries = _BACKENDS.setdefault(decision, [])
+    entries[:] = [e for e in entries if e[1] != name]
+    entries.append((rank, name, description))
+    entries.sort()
+
+
+def decisions() -> tuple[str, ...]:
+    return tuple(sorted(_BACKENDS))
+
+
+def backends_for(decision: str) -> tuple[str, ...]:
+    return tuple(name for _, name, _ in _BACKENDS.get(decision, ()))
+
+
+def ladder(decision: str, start: str | None = None) -> tuple[str, ...]:
+    """The rank-ordered fallback ladder for a decision, optionally starting
+    at ``start`` (an unknown start returns the full ladder)."""
+    names = backends_for(decision)
+    if start in names:
+        return names[names.index(start):]
+    return names
+
+
+class BackendRegistry:
+    """In-process resolution cache + tuning stats (one global instance)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._memo: dict[tuple[str, Any], BackendChoice] = {}
+        self._last: dict[str, tuple[Any, str]] = {}
+        self._announced: set[tuple[str, Any, str]] = set()
+        self.stats: dict[str, int] = {
+            "resolves": 0,
+            "pins": 0,
+            "memo_hits": 0,
+            "store_hits": 0,
+            "heuristic_calls": 0,
+        }
+
+    def _announce(self, decision: str, key: Any, choice: BackendChoice) -> None:
+        """One ``backend_select`` telemetry record per (decision, key,
+        choice) — selection provenance without per-step record spam."""
+        if not telemetry.enabled():
+            return
+        tag = (decision, _memo_key(key), choice.name)
+        with self._lock:
+            if tag in self._announced:
+                return
+            self._announced.add(tag)
+        telemetry.record_event(
+            "backend_select",
+            decision=decision,
+            key=list(key) if isinstance(key, tuple) else key,
+            choice=choice.name,
+            source=choice.source,
+        )
+
+    def resolve(
+        self,
+        decision: str,
+        key: Any,
+        heuristic: Callable[[], str],
+        pin: str | None = None,
+    ) -> BackendChoice:
+        with self._lock:
+            self.stats["resolves"] += 1
+        if pin is not None:
+            choice = BackendChoice(pin, "pin")
+            with self._lock:
+                self.stats["pins"] += 1
+                self._last[decision] = (key, pin)
+            self._announce(decision, key, choice)
+            return choice
+
+        ck = (decision, _memo_key(key))
+        with self._lock:
+            hit = self._memo.get(ck)
+        if hit is not None:
+            usable = hit.source not in STORE_SOURCES or _store_gate()
+            if usable:
+                with self._lock:
+                    self.stats["memo_hits"] += 1
+                    self._last[decision] = (key, hit.name)
+                return hit
+
+        choice: BackendChoice | None = None
+        if _store_gate():
+            from ..telemetry import store as _tstore
+
+            persisted = _tstore.policy_lookup(decision, key)
+            if persisted is not None and (
+                not backends_for(decision)
+                or persisted["choice"] in backends_for(decision)
+            ):
+                choice = BackendChoice(persisted["choice"], "policy")
+            else:
+                best = _tstore.measured_best(decision, key)
+                if best is not None and (
+                    not backends_for(decision)
+                    or best in backends_for(decision)
+                ):
+                    choice = BackendChoice(best, "measured")
+                    _tstore.policy_record(decision, key, best, "measured")
+            if choice is not None:
+                with self._lock:
+                    self.stats["store_hits"] += 1
+
+        if choice is None:
+            name = heuristic()
+            choice = BackendChoice(name, "heuristic")
+            with self._lock:
+                self.stats["heuristic_calls"] += 1
+            if _store_gate():
+                from ..telemetry import store as _tstore
+
+                _tstore.policy_record(decision, key, name, "heuristic")
+
+        with self._lock:
+            self._memo[ck] = choice
+            self._last[decision] = (key, choice.name)
+        self._announce(decision, key, choice)
+        return choice
+
+    def last(self, decision: str) -> tuple[Any, str] | None:
+        with self._lock:
+            return self._last.get(decision)
+
+
+def _store_gate() -> bool:
+    """Is the persistent policy store allowed to influence resolution
+    *right now*? Lazy import keeps telemetry fully out of the picture for
+    processes that never enable it."""
+    from ..telemetry import store as _tstore
+
+    return _tstore.store_active()
+
+
+_registry: BackendRegistry | None = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> BackendRegistry:
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = BackendRegistry()
+        return _registry
+
+
+def reset_registry() -> None:
+    """Drop the in-process resolution cache + stats (tests)."""
+    global _registry
+    with _registry_lock:
+        _registry = None
+
+
+def resolve(
+    decision: str,
+    key: Any,
+    heuristic: Callable[[], str],
+    pin: str | None = None,
+) -> BackendChoice:
+    return get_registry().resolve(decision, key, heuristic, pin=pin)
+
+
+def stats() -> dict[str, int]:
+    return dict(get_registry().stats)
+
+
+def last_choice(decision: str) -> str | None:
+    last = get_registry().last(decision)
+    return None if last is None else last[1]
+
+
+def last_key(decision: str) -> Any | None:
+    last = get_registry().last(decision)
+    return None if last is None else last[0]
+
+
+# -- call-site conveniences (the env reads kernel code used to do) ----------
+
+
+def calc_attn_backend(key: Any = ()) -> str:
+    """The attention backend for a runtime/step: explicit
+    MAGI_ATTENTION_KERNEL_BACKEND pins it; otherwise the policy cache /
+    measured history / the 'ffa' default decide."""
+    return resolve(
+        "calc_attn", key, lambda: "ffa",
+        pin=env_backend.kernel_backend_pin(),
+    ).name
+
+
+def tiles_pinned() -> bool:
+    """Explicit FFA block settings present (env FFA_BLOCK_Q/K): auto-tile
+    and mixed dispatch must stand down — explicit settings always win."""
+    return env_kernel.ffa_blocks_pinned()
+
+
+def gqa_pack_variant(kind: str) -> str:
+    """'gqa_packed' | 'plain' for the fwd / bwd-dq / bwd-dkv kernels. The
+    pack flags are explicit opt-ins, so these decisions are always pinned;
+    the call site's VMEM-residency guard still applies on top."""
+    if kind == "fwd":
+        flag = env_kernel.ffa_gqa_pack()
+        decision = "ffa_fwd"
+    elif kind == "dq":
+        flag = env_kernel.ffa_gqa_pack_dq()
+        decision = "ffa_bwd_dq"
+    elif kind == "dkv":
+        flag = env_kernel.ffa_gqa_pack_dkv()
+        decision = "ffa_bwd_dkv"
+    else:
+        raise ValueError(f"unknown gqa pack kind: {kind!r}")
+    return resolve(
+        decision, (), lambda: "plain",
+        pin="gqa_packed" if flag else "plain",
+    ).name
+
+
+def extent_clamp_enabled() -> bool:
+    """Lowering variant of the FFA kernel bodies: extent-clamped chunked
+    dots vs the legacy single-dot bodies."""
+    return (
+        resolve(
+            "ffa_lowering", (), lambda: "clamped",
+            pin="clamped" if env_kernel.ffa_extent_clamp() else "single_dot",
+        ).name
+        == "clamped"
+    )
+
+
+# -- backend registrations --------------------------------------------------
+
+register_backend(
+    "calc_attn", "ffa", 0, "Pallas flex-flash-attention (default)")
+register_backend(
+    "calc_attn", "sdpa", 1, "XLA dense reference")
+register_backend(
+    "calc_attn", "sdpa_online", 2,
+    "streamed dense reference — resilience ladder's last rung")
+register_backend("ffa_fwd", "plain", 0, "per-head fwd kernel")
+register_backend(
+    "ffa_fwd", "gqa_packed", 1, "grouped-head packed fwd kernel")
+register_backend("ffa_bwd", "fused", 0, "one-pass fused dq/dk/dv")
+register_backend(
+    "ffa_bwd", "split", 1, "split dq + dkv passes — fused's fallback rung")
+register_backend("ffa_bwd_dq", "plain", 0, "per-head dq kernel")
+register_backend("ffa_bwd_dq", "gqa_packed", 1, "packed dq kernel")
+register_backend("ffa_bwd_dkv", "gqa_packed", 0, "packed dkv (default on)")
+register_backend("ffa_bwd_dkv", "plain", 1, "per-head dkv kernel")
+register_backend(
+    "ffa_dispatch", "mixed", 0, "coarse+fine two-pass LSE-merged dispatch")
+register_backend("ffa_dispatch", "single", 1, "one plan, one tiling")
+register_backend(
+    "ffa_lowering", "clamped", 0, "extent-clamped chunked-dot bodies")
+register_backend(
+    "ffa_lowering", "single_dot", 1, "legacy full-tile dot bodies")
+register_backend(
+    "serve_decode", "paged_decode", 0, "Pallas ragged paged-decode kernel")
+register_backend(
+    "serve_decode", "gather_ffa", 1, "per-slot gather+FFA reference")
+register_backend(
+    "serve_decode", "dense", 2, "dense jnp softmax — last resort")
+
+# which env keys pin each decision (new BACKEND_* key first, legacy key
+# second) — provenance for reports and docs/env_variables.md
+PIN_KEYS: dict[str, tuple[str, ...]] = {
+    "calc_attn": ("MAGI_ATTENTION_KERNEL_BACKEND",),
+    "ffa_bwd": (
+        "MAGI_ATTENTION_BACKEND_FFA_BWD", "MAGI_ATTENTION_FFA_FUSED_BWD"),
+    "ffa_dispatch": (
+        "MAGI_ATTENTION_BACKEND_MIXED_BLOCKS",
+        "MAGI_ATTENTION_FFA_MIXED_BLOCKS"),
+    "serve_decode": (
+        "MAGI_ATTENTION_BACKEND_SERVE_DECODE",
+        "MAGI_ATTENTION_SERVE_DECODE_KERNEL"),
+    "ffa_fwd": ("MAGI_ATTENTION_FFA_GQA_PACK",),
+    "ffa_bwd_dq": ("MAGI_ATTENTION_FFA_GQA_PACK_DQ",),
+    "ffa_bwd_dkv": ("MAGI_ATTENTION_FFA_GQA_PACK_DKV",),
+    "ffa_lowering": ("MAGI_ATTENTION_FFA_EXTENT_CLAMP",),
+}

@@ -68,6 +68,22 @@ def auto_tile_enabled() -> bool:
     return _get_int("MAGI_ATTENTION_FFA_AUTO_TILE", 0) == 1
 
 
+def _overhead_elems() -> float:
+    """The per-grid-step fixed cost the scorers charge: the built-in
+    :data:`OVERHEAD_ELEMS` constant, or the store-fitted value when the
+    performance observatory's calibration loop is on
+    (telemetry/drift.fit_constants writes it; store.calibrated gates on
+    telemetry + MAGI_ATTENTION_CALIBRATION, so with the observatory off
+    this is exactly the constant and scores are bit-identical)."""
+    from ..env import backend as env_backend
+
+    if not env_backend.calibration_enabled():
+        return OVERHEAD_ELEMS
+    from ..telemetry import store as _tstore
+
+    return _tstore.calibrated("overhead_elems", OVERHEAD_ELEMS)
+
+
 def count_ffa_work(
     qr: np.ndarray,
     kr: np.ndarray,
@@ -183,6 +199,7 @@ def choose_blocks_multi(
     seen: set[tuple[int, int]] = set()
     best = None
     best_score = None
+    ov = _overhead_elems()
     for bq, bk in CANDIDATES:
         # clamp to the problem (same rule as default_blocks), then dedupe
         bq = min(bq, _round_up(sq, 16))
@@ -196,7 +213,7 @@ def choose_blocks_multi(
             count_ffa_work(qr, kr, lo, hi, sq, sk, bq, bk)
             for qr, kr, lo, hi in rank_geoms
         )
-        score = w * (bq * bk + OVERHEAD_ELEMS)
+        score = w * (bq * bk + ov)
         if best_score is None or score < best_score:
             best, best_score = (bq, bk), score
     chosen = best or (
@@ -290,6 +307,7 @@ def choose_blocks_per_pass_multi(
     """
     maybe_inject("vmem_check")
     cands = _band_candidates(rank_geoms, sq, sk)
+    ov = _overhead_elems()
 
     def score_pass(kind: str, allowed=None):
         seen: set[tuple[int, int]] = set()
@@ -314,7 +332,7 @@ def choose_blocks_per_pass_multi(
                 counter(qr, kr, lo, hi, sq, sk, bq, bk)
                 for qr, kr, lo, hi in rank_geoms
             )
-            score = w * (bq * bk + OVERHEAD_ELEMS)
+            score = w * (bq * bk + ov)
             if best_score is None or score < best_score:
                 best, best_score = (bq, bk), score
         return best
@@ -541,17 +559,22 @@ def choose_mixed_dispatch(
     plus a fine-block fragmented pass (merged via LSE merge), or run one
     plan as usual (None).
 
-    Gated by ``MAGI_ATTENTION_FFA_MIXED_BLOCKS``: "0" never splits, "1"
-    splits whenever a non-trivial partition with distinct tilings exists,
-    "auto" (default) additionally requires the cost model to favor the
-    split: score(coarse on dense) + score(fine on fragmented) + merge
-    overhead < score(coarse on everything), with score the same
-    padded-work + per-step-overhead model the tile scorer minimizes.
+    Selection flows through the backend registry's ``ffa_dispatch``
+    decision (kernels/registry.py): a 'single'/'mixed' pin
+    (MAGI_ATTENTION_BACKEND_MIXED_BLOCKS, or the legacy
+    MAGI_ATTENTION_FFA_MIXED_BLOCKS mapped 0/1) wins — 'mixed' still
+    degrades to None when the mask yields no non-trivial partition with
+    distinct tilings; unpinned geometries resolve against the policy cache
+    / measured history, falling back to the cost model: split wins when
+    score(coarse on dense) + score(fine on fragmented) + merge overhead <
+    score(coarse on everything), with score the same padded-work +
+    per-step-overhead model the tile scorer minimizes.
     """
-    from ..env.kernel import ffa_mixed_blocks
+    from ..env import backend as env_backend
+    from . import registry as _registry
 
-    mode = ffa_mixed_blocks()
-    if mode == "0" or len(qr) < 2:
+    pin = env_backend.mixed_blocks_pin()
+    if pin == "single" or len(qr) < 2:
         return None
     coarse = coarse_blocks or (
         min(256, _round_up(sq, 16)), min(512, _round_up(sk, NUM_LANES))
@@ -569,6 +592,8 @@ def choose_mixed_dispatch(
     if fine == coarse:
         return None
 
+    ov = _overhead_elems()
+
     def score(idx: np.ndarray, blocks: tuple[int, int]) -> int:
         # grid steps (incl. one dummy per empty q tile) pay fixed overhead;
         # only band-touching tiles pay compute — with extent clamping on,
@@ -583,7 +608,7 @@ def choose_mixed_dispatch(
                 qr[idx], kr[idx], d_lo[idx], d_hi[idx], blocks[0], blocks[1]
             ).sum()
         )
-        return tiles * blocks[0] * blocks[1] + w * OVERHEAD_ELEMS
+        return tiles * blocks[0] * blocks[1] + w * ov
 
     all_idx = np.arange(len(qr))
     single = score(all_idx, coarse)
@@ -593,7 +618,17 @@ def choose_mixed_dispatch(
         + sq * MERGE_OVERHEAD_PER_ROW
     )
     profitable = split < single
-    if mode != "1" and not profitable:
+    if pin == "mixed":
+        choice = "mixed"
+    else:
+        key = _mixed_dispatch_key(
+            qr, kr, d_lo, d_hi, sq, sk, d, dv, itemsize, coarse
+        )
+        choice = _registry.resolve(
+            "ffa_dispatch", key,
+            lambda: "mixed" if profitable else "single",
+        ).name
+    if choice != "mixed":
         return None
     result = MixedDispatch(
         dense_idx=dense_idx,
@@ -613,9 +648,23 @@ def choose_mixed_dispatch(
             fine_blocks=list(fine),
             single_score=single,
             split_score=split,
-            forced=mode == "1" and not profitable,
+            forced=not profitable,
         )
     return result
+
+
+def _mixed_dispatch_key(
+    qr, kr, d_lo, d_hi, sq, sk, d, dv, itemsize, coarse
+) -> tuple:
+    """Registry/store key of one mixed-dispatch decision: a digest of the
+    slice geometry (the mask-class signature) plus the static dims the
+    cost model consumes."""
+    import hashlib
+
+    h = hashlib.md5()
+    for arr in (qr, kr, d_lo, d_hi):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return (h.hexdigest()[:16], sq, sk, d, dv, itemsize, coarse[0], coarse[1])
 
 
 # ---------------------------------------------------------------------------

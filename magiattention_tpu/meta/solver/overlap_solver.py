@@ -30,6 +30,21 @@ from dataclasses import dataclass
 from ...common.enum import OverlapAlgType
 from ...config import OverlapConfig
 
+# built-in DCN cost constant: one DCN row costs ~8x an ICI row
+DCN_PER_ROW = 8.0
+
+
+def _calibrated_dcn_per_row() -> float:
+    """DCN_PER_ROW, overridden by the telemetry store's fitted constant
+    when calibration is on and a two_level_makespan fit has converged."""
+    from ...env import backend as env_backend
+
+    if not env_backend.calibration_enabled():
+        return DCN_PER_ROW
+    from ...telemetry import store as _store
+
+    return float(_store.calibrated("dcn_per_row", DCN_PER_ROW))
+
 
 @dataclass
 class OverlapStageCost:
@@ -93,9 +108,17 @@ class OverlapSolver:
         host_calc: float = 0.0,
         comm_per_row: float = 1.0,
         calc_per_area: float = 1.0,
-        dcn_per_row: float = 8.0,
+        dcn_per_row: float | None = None,
     ) -> tuple[list[int], list[OverlapStageCost]]:
-        """Returns (stage id per item, per-stage costs)."""
+        """Returns (stage id per item, per-stage costs).
+
+        ``dcn_per_row=None`` resolves through the telemetry store's
+        calibrated constant (fit from two_level_makespan drift
+        observations) and falls back to the built-in 8.0 when no store is
+        active or no fit has converged.
+        """
+        if dcn_per_row is None:
+            dcn_per_row = _calibrated_dcn_per_row()
         if not items:
             return [], []
         cfg = self.config
@@ -158,7 +181,7 @@ class OverlapSolver:
 
     @staticmethod
     def _costs(items, assign, degree, comm_per_row, calc_per_area,
-               dcn_per_row=8.0):
+               dcn_per_row=DCN_PER_ROW):
         costs = [OverlapStageCost() for _ in range(degree)]
         for it, st in zip(items, assign):
             costs[st].comm_cost += it.rows * comm_per_row

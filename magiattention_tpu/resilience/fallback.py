@@ -36,8 +36,19 @@ from .inject import should_fire
 # bounded retry budget for runtime/plan builds (attempts = 1 + RETRIES)
 PLAN_BUILD_RETRIES = 1
 
-# the final rung of the kernel ladder: the reference dense path
+# the final rung of the kernel ladder: the reference dense path. Kept as
+# a module constant for compatibility; _descend_ladder consults the
+# backend registry's calc_attn ladder, whose lowest-ranked rung is this.
 REFERENCE_BACKEND = "sdpa_online"
+
+
+def reference_backend() -> str:
+    """Last rung of the registry's ``calc_attn`` ladder — the backend the
+    kernel fallback chain pins when every tile rung has failed."""
+    from ..kernels import registry as _registry
+
+    rungs = _registry.ladder("calc_attn")
+    return rungs[-1] if rungs else REFERENCE_BACKEND
 
 
 def kernel_failure_types() -> tuple[type[BaseException], ...]:
@@ -142,17 +153,18 @@ def _descend_ladder(runtime, q, k, v, return_max_logits, first_err,
             )
             return result
     # last rung: the reference dense path (kernels/sdpa_online.py)
-    runtime._backend_override = REFERENCE_BACKEND
+    reference = reference_backend()
+    runtime._backend_override = reference
     try:
         result = runtime._calc_attn_impl(q, k, v, return_max_logits)
     except Exception as e:
         runtime._backend_override = None
         raise FallbackExhaustedError(
             "kernel fallback chain exhausted: tile ladder and the "
-            f"{REFERENCE_BACKEND} reference path all failed"
+            f"{reference} reference path all failed"
         ) from (first_err if isinstance(e, failures) else e)
     record_resilience_event(
         "recovered", "kernel_lowering", action_detail="reference_backend",
-        backend=REFERENCE_BACKEND,
+        backend=reference,
     )
     return result

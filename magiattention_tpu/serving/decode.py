@@ -18,6 +18,15 @@ Descent follows the resilience contract of ``ffa.ffa_bwd_pallas_dispatch``:
 recoverable failure types from :func:`kernel_failure_types`, descent only
 under ``MAGI_ATTENTION_FALLBACK=1`` (otherwise failures propagate), one
 ``resilience`` telemetry record per hop.
+
+Rung selection flows through the backend registry's ``serve_decode``
+decision (kernels/registry.py): a pin
+(MAGI_ATTENTION_BACKEND_SERVE_DECODE, or the legacy
+MAGI_ATTENTION_SERVE_DECODE_KERNEL mapped 1->paged_decode,
+0->gather_ffa) sets the starting rung; unpinned steps resolve against the
+policy cache / measured serve_step history, defaulting to the kernel
+rung. The ladder itself — which rungs exist and their descent order — is
+the registry's rank ordering, shared with the resilience module.
 """
 
 from __future__ import annotations
@@ -25,8 +34,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..env import backend as env_backend
 from ..env import resilience as env_resilience
-from ..env import serve as env_serve
+from ..kernels import registry as _registry
 from ..kernels.paged_decode import paged_decode_attn
 from ..kernels.paged_kv import PagedKVCache, gather_kv, paged_attn
 from ..resilience import fallback as _fallback
@@ -53,33 +63,37 @@ def decode_attn_step(
 
     Returns (out ``(max_seqs, hq, dv)``, lse ``(max_seqs, hq)``).
     """
-    mode = env_serve.serve_decode_kernel()
+    S, hq, d = q_batch.shape
+    hk = cache.k_pages.shape[2]
+    dv = cache.v_pages.shape[-1]
+    key = (S, hq, hk, d, dv, str(q_batch.dtype))
+    start = _registry.resolve(
+        "serve_decode", key, lambda: "paged_decode",
+        pin=env_backend.serve_decode_pin(),
+    ).name
+    rungs = _registry.ladder("serve_decode", start)
     failures = _fallback.kernel_failure_types()
-    if mode != "0":
+    for i, rung in enumerate(rungs):
         try:
-            maybe_inject("serve_decode")
-            return paged_decode_attn(
-                q_batch, cache, softmax_scale=softmax_scale
-            )
+            if rung == "paged_decode":
+                maybe_inject("serve_decode")
+                return paged_decode_attn(
+                    q_batch, cache, softmax_scale=softmax_scale
+                )
+            if rung == "gather_ffa":
+                return _gather_ffa_decode(
+                    q_batch, cache, host_lengths, softmax_scale
+                )
+            return _dense_decode(q_batch, cache, host_lengths, softmax_scale)
         except failures as e:
-            if not env_resilience.is_fallback_enable():
+            if i + 1 >= len(rungs) or not env_resilience.is_fallback_enable():
                 raise
             _fallback.record_resilience_event(
                 "fallback", "serve_decode",
-                action_detail="paged_decode_to_gather_ffa",
+                action_detail=f"{rung}_to_{rungs[i + 1]}",
                 error=type(e).__name__,
             )
-    try:
-        return _gather_ffa_decode(q_batch, cache, host_lengths, softmax_scale)
-    except failures as e:
-        if not env_resilience.is_fallback_enable():
-            raise
-        _fallback.record_resilience_event(
-            "fallback", "serve_decode",
-            action_detail="gather_ffa_to_dense",
-            error=type(e).__name__,
-        )
-    return _dense_decode(q_batch, cache, host_lengths, softmax_scale)
+    raise AssertionError("serve_decode ladder is empty")  # pragma: no cover
 
 
 def _gather_ffa_decode(q_batch, cache, host_lengths, softmax_scale):

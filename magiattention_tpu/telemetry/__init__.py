@@ -32,3 +32,9 @@ from .registry import (  # noqa: F401
     summary,
 )
 from .stats import band_area  # noqa: F401
+from .store import (  # noqa: F401
+    TelemetryStore,
+    StoreState,
+    store_active,
+)
+from .drift import fit_constants, fit_scale, scan  # noqa: F401

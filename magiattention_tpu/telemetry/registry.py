@@ -17,7 +17,7 @@ from contextlib import contextmanager
 from typing import Any
 
 from ..env import general as env_general
-from .export import JsonlSink
+from .export import JsonlSink, process_unique_path
 
 SCHEMA_VERSION = 1
 
@@ -37,9 +37,9 @@ class TelemetryCollector:
         self.counters: dict[str, int] = {}
         self.gauges: dict[str, float] = {}
         self.last_event: dict[str, dict[str, Any]] = {}
-        self._sink = JsonlSink(
-            os.path.join(directory, f"magiattention-{os.getpid()}.jsonl")
-        )
+        # host+pid+token unique name: concurrent hosts of a multi-slice
+        # job never share a file (export.py also makes each line atomic)
+        self._sink = JsonlSink(process_unique_path(directory, "magiattention"))
 
     @property
     def path(self) -> str:
@@ -69,6 +69,12 @@ class TelemetryCollector:
             )
             self.last_event[kind] = record
             self._sink.write(record)
+        # feed the persistent cross-run store (outside the collector lock;
+        # the store has its own). No-op unless the store is active and the
+        # kind is one it aggregates.
+        from . import store as _store
+
+        _store.ingest_event(record)
 
     def close(self) -> None:
         self._sink.close()

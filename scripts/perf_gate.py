@@ -1,0 +1,180 @@
+"""Performance-regression gate over ``benchmarks/history/bench_*.csv``.
+
+Each bench CSV is append-only run history: one row per (config, run), with
+``utc``/``commit`` stamps, config columns (mask family, seq, blocks, ...)
+and measured metric columns. This gate compares, per config group, the
+NEWEST row against the PREVIOUS one and fails on a >10% regression:
+
+- **lower-is-better** metrics: column names containing ``ms``, ``time``,
+  ``latency`` or ``makespan`` (numeric values only — string columns like
+  ``timing_mode`` never qualify);
+- **higher-is-better** metrics: names containing ``tflops``, ``mfu``,
+  ``rate`` or ``speedup``.
+
+A regression is WAIVED when the newest row carries a ``BENCH`` note in any
+string field (e.g. ``timing_mode=chained_cpu BENCH: new solver trades 12%
+headline for 2x sparse``) — the note is the reviewed acknowledgement that
+the regression is intentional. Rows lacking a prior same-config row are
+informational only (new configs can't regress).
+
+Usage::
+
+    python scripts/perf_gate.py                      # gate the default dir
+    python scripts/perf_gate.py --history benchmarks/history --threshold 0.1
+    python scripts/perf_gate.py --json               # machine-readable
+
+Exit status: 0 = no unwaived regressions, 1 = at least one, 2 = no bench
+history found (treated as an error so CI misconfiguration is loud).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import glob
+import json
+import os
+import sys
+
+LOWER_BETTER = ("ms", "time", "latency", "makespan")
+HIGHER_BETTER = ("tflops", "mfu", "rate", "speedup")
+# stamp columns: never config key, never metric
+STAMPS = ("utc", "commit")
+WAIVER_TAG = "BENCH"
+
+
+def _metric_direction(name: str) -> str | None:
+    """'down' (lower better) / 'up' (higher better) / None (config)."""
+    n = name.lower()
+    # higher-better first: 'rate' would otherwise never match after 'time'
+    if any(tag in n for tag in HIGHER_BETTER):
+        return "up"
+    if any(tag in n for tag in LOWER_BETTER):
+        return "down"
+    return None
+
+
+def _as_float(val: str) -> float | None:
+    try:
+        return float(val)
+    except (TypeError, ValueError):
+        return None
+
+
+def _config_key(row: dict, metrics: dict[str, str]) -> tuple:
+    return tuple(
+        (k, v)
+        for k, v in row.items()
+        if k not in metrics and k not in STAMPS
+    )
+
+
+def _has_waiver(row: dict) -> bool:
+    return any(
+        isinstance(v, str) and WAIVER_TAG in v for v in row.values()
+    )
+
+
+def gate_file(path: str, threshold: float) -> list[dict]:
+    """Regression findings for one CSV (empty = clean)."""
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    if len(rows) < 2:
+        return []
+    # a column is a metric only if its name matches AND it parses numeric
+    # somewhere — 'timing_mode' stays config despite containing 'time'
+    metrics: dict[str, str] = {}
+    for name in rows[0]:
+        direction = _metric_direction(name)
+        if direction and any(_as_float(r.get(name)) is not None for r in rows):
+            metrics[name] = direction
+
+    groups: dict[tuple, list[dict]] = {}
+    for row in rows:  # file order == append order == chronology
+        groups.setdefault(_config_key(row, metrics), []).append(row)
+
+    findings = []
+    for key, grp in groups.items():
+        if len(grp) < 2:
+            continue
+        new, old = grp[-1], grp[-2]
+        waived = _has_waiver(new)
+        for name, direction in metrics.items():
+            nv, ov = _as_float(new.get(name)), _as_float(old.get(name))
+            if nv is None or ov is None or ov == 0:
+                continue
+            change = (nv - ov) / abs(ov)
+            regressed = (
+                change > threshold
+                if direction == "down"
+                else change < -threshold
+            )
+            if not regressed:
+                continue
+            findings.append({
+                "file": os.path.basename(path),
+                "config": dict(key),
+                "metric": name,
+                "direction": direction,
+                "old": ov,
+                "new": nv,
+                "change": change,
+                "old_commit": old.get("commit"),
+                "new_commit": new.get("commit"),
+                "waived": waived,
+            })
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--history", default="benchmarks/history",
+        help="directory of bench_*.csv files (default benchmarks/history)",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="relative regression threshold (default 0.10 = 10%%)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print findings as JSON instead of text",
+    )
+    args = ap.parse_args(argv)
+
+    paths = sorted(glob.glob(os.path.join(args.history, "bench_*.csv")))
+    if not paths:
+        print(f"no bench_*.csv under {args.history}", file=sys.stderr)
+        return 2
+
+    findings: list[dict] = []
+    for path in paths:
+        findings.extend(gate_file(path, args.threshold))
+    blocking = [f for f in findings if not f["waived"]]
+
+    if args.json:
+        print(json.dumps({
+            "files": len(paths),
+            "threshold": args.threshold,
+            "findings": findings,
+            "blocking": len(blocking),
+        }, indent=2))
+    else:
+        print(
+            f"perf gate: {len(paths)} file(s), threshold "
+            f"{args.threshold:.0%}, {len(findings)} regression(s), "
+            f"{len(blocking)} blocking"
+        )
+        for f in findings:
+            cfg = " ".join(f"{k}={v}" for k, v in f["config"].items() if v)
+            tag = "WAIVED" if f["waived"] else "FAIL"
+            print(
+                f"  [{tag}] {f['file']} {f['metric']}: {f['old']} -> "
+                f"{f['new']} ({f['change']:+.1%}, "
+                f"{f['old_commit']}..{f['new_commit']}) {cfg}"
+            )
+    return 1 if blocking else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,8 @@ Usage::
     python scripts/telemetry_report.py /tmp/tel          # a directory
     python scripts/telemetry_report.py /tmp/tel/*.jsonl  # or files
     python scripts/telemetry_report.py --json /tmp/tel   # machine-readable
+    python scripts/telemetry_report.py --store /tmp/tel/store --json /tmp/tel
+    python scripts/telemetry_report.py --schema            # --json field docs
 """
 
 from __future__ import annotations
@@ -25,6 +27,172 @@ import os
 import sys
 
 SUPPORTED_SCHEMA = 1
+
+# Field documentation for every --json section (printed by --schema).
+# Top-level keys of the --json object == keys here; each maps field name ->
+# one-line meaning. Sections are omitted from the output when no record of
+# the backing kind was seen.
+SECTION_SCHEMAS: dict[str, dict[str, str]] = {
+    "record_counts": {
+        "<kind>": "number of records of each telemetry kind seen",
+    },
+    "dispatch": {
+        "solves": "dispatch_meta records (solver runs)",
+        "alg": "balance algorithm of the last solve",
+        "cp_size": "context-parallel world size",
+        "num_chunks": "chunks balanced per rank",
+        "per_rank_area": "attention area per rank after balancing",
+        "max_area": "largest per-rank area",
+        "lower_bound": "area lower bound (perfect balance)",
+        "balance_ratio": "max_area / lower_bound (1.0 = perfect)",
+    },
+    "comm_plan": {
+        "builds": "plan_build records",
+        "planner": "planner of the last build (static/dynamic)",
+        "stages": "per-stage lowering + payload/wire/padding rows",
+    },
+    "attn_step": {
+        "steps": "attn_step records",
+        "backend": "kernel backend of the last step",
+        "overlap_degree": "comm/compute overlap stages",
+        "block_q": "FFA q tile rows",
+        "block_k": "FFA k tile cols",
+        "payload_bytes_total": "useful comm bytes, last step",
+        "wire_bytes_total": "on-wire comm bytes, last step",
+        "padding_bytes_total": "alignment-padding waste, last step",
+        "band_elems": "true mask-band elements",
+        "padded_elems": "padded kernel-grid elements",
+        "est_flops_fwd": "forward FLOPs over the true band",
+        "padded_flops_fwd": "forward FLOPs over the padded grid",
+        "stages": "per-stage comm detail of the last step",
+        "wall_ms_last": "host wall of the last step (ms)",
+        "wall_ms_min": "fastest step wall (ms; post-compile)",
+        "bwd_mode": "backward mode of the last step (fused/split)",
+        "bwd_modes": "step counts per backward mode",
+    },
+    "ffa_plans": {
+        "plans": "ffa_plan records",
+        "padded_elems": "padded grid elements, all plans",
+        "band_elems": "true band elements, all plans",
+        "executed_elems": "extent-clamped executed elements",
+        "padding_ratio": "padded / band",
+        "executed_ratio": "executed / band",
+        "extent_clamp": "extent clamping active on the last plan",
+        "frag_histogram": "slice counts bucketed by tile-cover ratio",
+    },
+    "mixed_dispatch": {
+        "splits": "mixed_dispatch records (accepted splits)",
+        "forced": "splits forced by pin rather than profitability",
+        "num_dense": "slices routed to the coarse tiling, last split",
+        "num_frag": "slices routed to the fine tiling, last split",
+        "coarse_blocks": "coarse (bq, bk)",
+        "fine_blocks": "fine (bq, bk)",
+        "single_score": "modeled cost of the single-tiling plan",
+        "split_score": "modeled cost of the mixed plan",
+    },
+    "tile_policy": {
+        "picks": "tile_policy records",
+        "mode": "selection mode of the last pick",
+        "fwd_blocks": "forward (bq, bk)",
+        "dq_blocks": "dq-pass blocks (null = inherit fwd)",
+        "dkv_blocks": "dkv-pass blocks (null = inherit fwd)",
+        "candidates_scored": "tilings scored by the cost model",
+    },
+    "runtime_cache": {
+        "hits": "runtime LRU hits",
+        "misses": "runtime LRU misses",
+        "evictions": "runtime LRU evictions",
+        "size": "current entries",
+        "maxsize": "capacity",
+    },
+    "plan_verify": {
+        "runs": "plan_verify records",
+        "planner": "planner verified last",
+        "rules_run": "verifier rules executed",
+        "errors_total": "errors across runs",
+        "warnings_total": "warnings across runs",
+        "fired_rules": "rules that fired at least once",
+        "wall_ms_last": "last verify wall (ms)",
+        "wall_ms_total": "total verify wall (ms)",
+    },
+    "kernel_audit": {
+        "runs": "kernel_audit records",
+        "kernels": "kernels audited",
+        "configs": "configs per kernel",
+        "rules_run": "audit rules executed",
+        "errors_total": "errors across runs",
+        "warnings_total": "warnings across runs",
+        "fired_rules": "rules that fired at least once",
+        "vmem_worst_bytes": "worst-case modeled VMEM residency",
+        "vmem_worst_config": "config hitting the worst case",
+        "vmem_allowed_bytes": "modeled VMEM budget",
+    },
+    "resilience": {
+        "events": "resilience records",
+        "injected": "faults injected",
+        "guard_trips": "numeric guard trips",
+        "fallback_hops": "fallback ladder hops",
+        "retries": "bounded retries",
+        "recovered": "successful recoveries",
+        "hops_by_site": "fallback/retry counts per site",
+    },
+    "serve": {
+        "steps": "serve_step records",
+        "admitted_total": "requests admitted",
+        "evicted_total": "requests evicted",
+        "completed_total": "requests completed",
+        "prefill_tokens_total": "prefill tokens processed",
+        "decode_tokens_total": "decode tokens produced",
+        "occupancy_mean": "mean slot occupancy",
+        "pages_in_use_last": "KV pages in use after the last step",
+        "pages_in_use_max": "peak KV pages in use",
+        "wall_ms_mean": "mean step wall (ms)",
+        "wall_ms_max": "max step wall (ms)",
+    },
+    "plan_solve": {
+        "events": "plan_solve records",
+        "solves": "actual solver runs",
+        "cache_hits": "plan-cache hits",
+        "cold": "from-scratch solves",
+        "incremental": "incremental re-solves",
+        "planners": "record counts per planner",
+        "rows_total": "chunk rows seen by solves",
+        "rows_resolved": "chunk rows actually re-solved",
+        "resolve_fraction": "rows_resolved / rows_total",
+        "incremental_resolve_fraction": "same, incremental solves only",
+        "wall_ms_total": "total solver wall (ms)",
+        "wall_ms_mean": "mean solver wall (ms)",
+        "two_level_solves": "solves priced with the (dcn, ici) model",
+    },
+    "hier_comm": {
+        "plans": "hier_plan records",
+        "dcn_rows": "DCN rows after dedup, last plan",
+        "flat_dcn_rows": "DCN rows a flat plan would move",
+        "dcn_dedup_ratio": "flat / dedup DCN rows",
+    },
+    "backend_select": {
+        "selections": "backend_select records (one per decision+key+choice)",
+        "by_decision": "per decision: choice counts, source counts, last",
+        "sources": "total counts per resolution source "
+                   "(pin/policy/measured/heuristic)",
+    },
+    "model_drift": {
+        "findings": "model_drift records (rel_err past threshold)",
+        "by_model": "per cost model: count, worst rel_err, last alpha",
+        "worst": "the single worst finding (model, rel_err, predicted_ms, "
+                 "measured_ms)",
+    },
+    "store": {
+        "dir": "store directory read (--store)",
+        "policy_entries": "persisted registry decisions",
+        "policy_by_decision": "persisted decision counts per decision name",
+        "measure_entries": "aggregated (decision, key) measurement entries",
+        "history": "run-history aggregate counts per kind",
+        "observations": "cost-model observation counts per model",
+        "calibration": "fitted constants {name: {value, n}}",
+        "drift_rows": "persisted drift findings",
+    },
+}
 
 
 def load_records(paths: list[str]) -> list[dict]:
@@ -319,7 +487,92 @@ def aggregate(records: list[dict]) -> dict:
             "flat_dcn_rows": last.get("flat_dcn_rows"),
             "dcn_dedup_ratio": last.get("dcn_dedup_ratio"),
         }
+
+    selects = kinds.get("backend_select", [])
+    if selects:
+        by_decision: dict[str, dict] = {}
+        sources: dict[str, int] = {}
+        for r in selects:
+            dec = r.get("decision", "?")
+            d = by_decision.setdefault(
+                dec, {"choices": {}, "sources": {}, "last_choice": None}
+            )
+            choice = r.get("choice", "?")
+            src = r.get("source", "?")
+            d["choices"][choice] = d["choices"].get(choice, 0) + 1
+            d["sources"][src] = d["sources"].get(src, 0) + 1
+            d["last_choice"] = choice
+            sources[src] = sources.get(src, 0) + 1
+        agg["backend_select"] = {
+            "selections": len(selects),
+            "by_decision": {
+                k: by_decision[k] for k in sorted(by_decision)
+            },
+            "sources": dict(sorted(sources.items())),
+        }
+
+    drifts = kinds.get("model_drift", [])
+    if drifts:
+        by_model: dict[str, dict] = {}
+        worst = None
+        for r in drifts:
+            m = r.get("model", "?")
+            rel = r.get("rel_err")
+            d = by_model.setdefault(
+                m, {"count": 0, "max_rel_err": None, "alpha_last": None}
+            )
+            d["count"] += 1
+            if rel is not None:
+                if d["max_rel_err"] is None or rel > d["max_rel_err"]:
+                    d["max_rel_err"] = rel
+                if worst is None or rel > worst["rel_err"]:
+                    worst = {
+                        "model": m,
+                        "rel_err": rel,
+                        "predicted_ms": r.get("predicted_ms"),
+                        "measured_ms": r.get("measured_ms"),
+                    }
+            if r.get("alpha") is not None:
+                d["alpha_last"] = r["alpha"]
+        agg["model_drift"] = {
+            "findings": len(drifts),
+            "by_model": {k: by_model[k] for k in sorted(by_model)},
+            "worst": worst,
+        }
     return agg
+
+
+def aggregate_store(store_dir: str) -> dict:
+    """The persistent store's aggregate view (--store): reads
+    ``store.json`` + ``history-*.jsonl`` via the package's own loader so
+    the report agrees byte-for-byte with what the registry reads back."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from magiattention_tpu.telemetry.store import _load_from_disk
+
+    state = _load_from_disk(store_dir)
+    policy_by_decision: dict[str, int] = {}
+    for k in state.policy:
+        dec = k.split("|", 1)[0]
+        policy_by_decision[dec] = policy_by_decision.get(dec, 0) + 1
+    history: dict[str, int] = {}
+    for h in state.history.values():
+        kind = h.get("kind", "?")
+        history[kind] = history.get(kind, 0) + 1
+    return {
+        "dir": store_dir,
+        "policy_entries": len(state.policy),
+        "policy_by_decision": dict(sorted(policy_by_decision.items())),
+        "measure_entries": len(state.entries),
+        "history": dict(sorted(history.items())),
+        "observations": {
+            m: len(v) for m, v in sorted(state.observations.items())
+        },
+        "calibration": {
+            k: {"value": v.get("value"), "n": v.get("n")}
+            for k, v in sorted(state.calibration.items())
+        },
+        "drift_rows": len(state.drift),
+    }
 
 
 def _fmt_bytes(n) -> str:
@@ -571,25 +824,94 @@ def format_summary(agg: dict) -> str:
             f"vs flat {hc['flat_dcn_rows']} "
             f"(dedup x{hc['dcn_dedup_ratio']:.2f})"
         )
+
+    bs = agg.get("backend_select")
+    if bs:
+        lines.append("")
+        srcs = " ".join(f"{k}={v}" for k, v in bs["sources"].items())
+        lines.append(
+            f"backend selections={bs['selections']} (sources: {srcs})"
+        )
+        for dec, d in bs["by_decision"].items():
+            choices = " ".join(
+                f"{k}={v}" for k, v in sorted(d["choices"].items())
+            )
+            lines.append(f"  {dec}: {choices} (last={d['last_choice']})")
+
+    dr = agg.get("model_drift")
+    if dr:
+        lines.append("")
+        lines.append(f"model drift findings={dr['findings']}")
+        for m, d in dr["by_model"].items():
+            rel = d["max_rel_err"]
+            rel_s = f"{rel:.2f}" if rel is not None else "?"
+            alpha = d["alpha_last"]
+            alpha_s = f"{alpha:.3g}" if alpha is not None else "?"
+            lines.append(
+                f"  {m}: {d['count']} finding(s), worst rel_err={rel_s}, "
+                f"fitted scale alpha={alpha_s}"
+            )
+        w = dr.get("worst")
+        if w and w.get("predicted_ms") is not None:
+            lines.append(
+                f"  worst: {w['model']} predicted {w['predicted_ms']:.2f} ms"
+                f" vs measured {w['measured_ms']:.2f} ms"
+            )
+
+    so = agg.get("store")
+    if so:
+        lines.append("")
+        hist = " ".join(f"{k}={v}" for k, v in so["history"].items()) or "none"
+        obs = (
+            " ".join(f"{k}={v}" for k, v in so["observations"].items())
+            or "none"
+        )
+        lines.append(
+            f"store [{so['dir']}]: policy={so['policy_entries']} "
+            f"measure_entries={so['measure_entries']} "
+            f"drift_rows={so['drift_rows']}"
+        )
+        lines.append(f"  history: {hist}")
+        lines.append(f"  observations: {obs}")
+        for name, c in so["calibration"].items():
+            lines.append(
+                f"  calibrated {name}={c['value']:.4g} (n={c['n']})"
+            )
     return "\n".join(lines)
 
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
-        "paths", nargs="+",
+        "paths", nargs="*",
         help="telemetry JSONL files or directories containing them",
     )
     ap.add_argument(
         "--json", action="store_true",
         help="print the aggregate as JSON instead of the text summary",
     )
+    ap.add_argument(
+        "--store", metavar="DIR",
+        help="also summarize a persistent telemetry store directory "
+             "(store.json + history-*.jsonl) as the 'store' section",
+    )
+    ap.add_argument(
+        "--schema", action="store_true",
+        help="print the --json section/field documentation and exit",
+    )
     args = ap.parse_args(argv)
+    if args.schema:
+        print(json.dumps(SECTION_SCHEMAS, indent=2))
+        return 0
+    if not args.paths and not args.store:
+        ap.error("paths required (or --store / --schema)")
     records = load_records(args.paths)
-    if not records:
+    if not records and not args.store:
         print("no telemetry records found", file=sys.stderr)
         return 1
     agg = aggregate(records)
+    if args.store:
+        agg["store"] = aggregate_store(args.store)
     if args.json:
         print(json.dumps(agg, indent=2))
     else:

@@ -1,0 +1,269 @@
+"""Unified backend registry (kernels/registry.py): pin > cached/measured
+policy > heuristic precedence, warm-restart zero-re-tuning, the legacy
+env-flag pin mapping with its one-time deprecation notice, and the
+telemetry-off bit-identity contract (docs/observability.md)."""
+
+import logging
+
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.env import backend as env_backend
+from magiattention_tpu.kernels import registry as kreg
+from magiattention_tpu.telemetry import store as tstore
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observatory():
+    telemetry.reset()
+    tstore.reset()
+    kreg.reset_registry()
+    env_backend._warned_legacy.clear()
+    yield
+    telemetry.reset()
+    tstore.reset()
+    kreg.reset_registry()
+    env_backend._warned_legacy.clear()
+
+
+@pytest.fixture
+def active_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("MAGI_ATTENTION_STORE_DIR", str(tmp_path / "store"))
+    return str(tmp_path / "store")
+
+
+# -- precedence -------------------------------------------------------------
+
+
+def test_pin_beats_policy_beats_heuristic(active_store):
+    key = (7, 128, 256)
+    tstore.policy_record("ffa_bwd", key, "split", "measured")
+
+    pinned = kreg.resolve("ffa_bwd", key, lambda: "fused", pin="fused")
+    assert (pinned.name, pinned.source) == ("fused", "pin")
+
+    cached = kreg.resolve("ffa_bwd", key, lambda: "fused")
+    assert (cached.name, cached.source) == ("split", "policy")
+
+    fresh = kreg.resolve("ffa_bwd", (9, 9, 9), lambda: "fused")
+    assert (fresh.name, fresh.source) == ("fused", "heuristic")
+    assert kreg.stats()["heuristic_calls"] == 1
+
+
+def test_measured_best_beats_heuristic(active_store):
+    """Enough ok measurements promote the fastest backend over the
+    heuristic, and the promotion is persisted as a policy row."""
+    key = {"mask_sig": "m", "mesh_sig": "c", "env_sig": "e"}
+    for ms in (5.0, 6.0):
+        tstore.record_measurement("calc_attn", key, "sdpa", ms)
+    for ms in (50.0, 60.0):
+        tstore.record_measurement("calc_attn", key, "ffa", ms)
+
+    choice = kreg.resolve("calc_attn", key, lambda: "ffa")
+    assert (choice.name, choice.source) == ("sdpa", "measured")
+    persisted = tstore.policy_lookup("calc_attn", key)
+    assert persisted["choice"] == "sdpa" and persisted["source"] == "measured"
+
+
+def test_unregistered_measured_backend_is_rejected(active_store):
+    """A measured/policy name not in the registered ladder (stale store
+    from an older build) never wins — the heuristic runs instead."""
+    key = (1, 2)
+    for ms in (1.0, 2.0):
+        tstore.record_measurement("ffa_bwd", key, "bogus", ms)
+    choice = kreg.resolve("ffa_bwd", key, lambda: "fused")
+    assert (choice.name, choice.source) == ("fused", "heuristic")
+
+
+def test_heuristic_memoized_per_key():
+    calls = []
+
+    def heuristic():
+        calls.append(1)
+        return "fused"
+
+    for _ in range(3):
+        assert kreg.resolve("ffa_bwd", (1, 2, 3), heuristic).name == "fused"
+    assert len(calls) == 1
+    assert kreg.stats()["memo_hits"] == 2
+    assert kreg.resolve("ffa_bwd", (4, 5, 6), heuristic).name == "fused"
+    assert len(calls) == 2
+
+
+def test_warm_policy_cache_makes_zero_tuning_decisions(active_store):
+    """Acceptance: a warm restart (fresh process state, persisted store)
+    resolves every known key from the policy cache — zero heuristic
+    calls."""
+    keys = [(1,), (2,), (3,)]
+    for k in keys:
+        kreg.resolve("ffa_bwd", k, lambda: "fused")
+    assert kreg.stats()["heuristic_calls"] == len(keys)
+
+    # "restart": drop all in-process state; the store directory survives
+    kreg.reset_registry()
+    tstore.reset()
+
+    for k in keys:
+        choice = kreg.resolve(
+            "ffa_bwd", k, lambda: pytest.fail("re-tuned on a warm cache")
+        )
+        assert (choice.name, choice.source) == ("fused", "policy")
+    stats = kreg.stats()
+    assert stats["heuristic_calls"] == 0
+    assert stats["store_hits"] == len(keys)
+
+
+def test_store_sourced_memo_dies_with_telemetry(active_store, monkeypatch):
+    """Flipping telemetry off mid-process stops store-sourced decisions
+    from applying: resolution returns to the pure heuristic."""
+    key = (11,)
+    tstore.policy_record("ffa_bwd", key, "split", "measured")
+    assert kreg.resolve("ffa_bwd", key, lambda: "fused").source == "policy"
+
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "0")
+    choice = kreg.resolve("ffa_bwd", key, lambda: "fused")
+    assert (choice.name, choice.source) == ("fused", "heuristic")
+
+
+def test_heuristic_only_when_telemetry_off():
+    """Bit-identity contract: telemetry off => no store reads, no store
+    writes, pure heuristic resolution."""
+    choice = kreg.resolve("calc_attn", ("k",), lambda: "ffa")
+    assert (choice.name, choice.source) == ("ffa", "heuristic")
+    assert kreg.stats()["store_hits"] == 0
+    assert tstore.get_store() is None
+
+
+def test_dict_keys_resolve_and_memoize():
+    """calc_attn's policy key is a dict — unhashable, canonicalized for
+    the memo while store joins keep the original mapping."""
+    key = {"mask_sig": "mA", "mesh_sig": "cp4", "env_sig": "eA"}
+    calls = []
+    kreg.resolve("calc_attn", key, lambda: calls.append(1) or "ffa")
+    # key order must not matter (canonical sorted-JSON memo key)
+    reordered = {"env_sig": "eA", "mask_sig": "mA", "mesh_sig": "cp4"}
+    kreg.resolve("calc_attn", reordered, lambda: calls.append(1) or "ffa")
+    assert len(calls) == 1
+
+
+def test_calc_attn_backend_pin(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_KERNEL_BACKEND", "sdpa")
+    assert kreg.calc_attn_backend({"mask_sig": "x"}) == "sdpa"
+    monkeypatch.delenv("MAGI_ATTENTION_KERNEL_BACKEND")
+    assert kreg.calc_attn_backend({"mask_sig": "x"}) == "ffa"
+
+
+# -- ladders ----------------------------------------------------------------
+
+
+def test_ladders_expose_fallback_order():
+    assert kreg.ladder("calc_attn") == ("ffa", "sdpa", "sdpa_online")
+    assert kreg.ladder("serve_decode") == (
+        "paged_decode", "gather_ffa", "dense")
+    assert kreg.ladder("serve_decode", "gather_ffa") == (
+        "gather_ffa", "dense")
+    assert kreg.ladder("serve_decode", "unknown") == (
+        "paged_decode", "gather_ffa", "dense")
+    # the resilience module's reference rung is the calc_attn ladder's last
+    from magiattention_tpu.resilience.fallback import reference_backend
+    assert reference_backend() == "sdpa_online"
+
+
+def test_every_decision_documents_its_pin_keys():
+    for decision in kreg.decisions():
+        assert kreg.backends_for(decision), decision
+        assert decision in kreg.PIN_KEYS, decision
+
+
+# -- legacy env-flag mapping ------------------------------------------------
+
+
+def test_legacy_ffa_fused_bwd_flag_matrix(monkeypatch):
+    from magiattention_tpu.kernels.ffa import (
+        FFAParams, bwd_mode_key, fused_bwd_feasible, resolved_bwd_mode,
+    )
+    from magiattention_tpu.kernels.tile_policy import choose_bwd_mode
+
+    params = FFAParams(
+        num_work=4, num_work_t=4, num_q_tiles=2, num_k_tiles=2,
+        block_q=128, block_k=128, softmax_scale=1.0, softcap=0.0,
+        group=1, interpret=True,
+    )
+    sqp, d, dv, itemsize = 256, 32, 32, 4
+    assert fused_bwd_feasible(params, sqp, d, dv, itemsize)
+
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_FUSED_BWD", "0")
+    assert resolved_bwd_mode(params, sqp, d, dv, itemsize) == "split"
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_FUSED_BWD", "1")
+    assert resolved_bwd_mode(params, sqp, d, dv, itemsize) == "fused"
+
+    # unset: the registry heuristic is exactly the legacy cost model
+    monkeypatch.delenv("MAGI_ATTENTION_FFA_FUSED_BWD")
+    key = bwd_mode_key(params, d, dv, itemsize)
+    expected = choose_bwd_mode(*key[:7], dv, itemsize=itemsize, group=1)
+    assert resolved_bwd_mode(params, sqp, d, dv, itemsize) == expected
+
+    # the new BACKEND_* key outranks the legacy flag
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_FUSED_BWD", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_BACKEND_FFA_BWD", "split")
+    assert resolved_bwd_mode(params, sqp, d, dv, itemsize) == "split"
+
+
+def test_legacy_pin_mappings(monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_MIXED_BLOCKS", "1")
+    assert env_backend.mixed_blocks_pin() == "mixed"
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_MIXED_BLOCKS", "0")
+    assert env_backend.mixed_blocks_pin() == "single"
+    monkeypatch.setenv("MAGI_ATTENTION_BACKEND_MIXED_BLOCKS", "mixed")
+    assert env_backend.mixed_blocks_pin() == "mixed"
+
+    monkeypatch.setenv("MAGI_ATTENTION_SERVE_DECODE_KERNEL", "0")
+    assert env_backend.serve_decode_pin() == "gather_ffa"
+    monkeypatch.setenv("MAGI_ATTENTION_SERVE_DECODE_KERNEL", "1")
+    assert env_backend.serve_decode_pin() == "paged_decode"
+    monkeypatch.setenv("MAGI_ATTENTION_BACKEND_SERVE_DECODE", "dense")
+    assert env_backend.serve_decode_pin() == "dense"
+
+    # "auto" / unset means no pin at all
+    monkeypatch.delenv("MAGI_ATTENTION_BACKEND_SERVE_DECODE")
+    monkeypatch.setenv("MAGI_ATTENTION_SERVE_DECODE_KERNEL", "auto")
+    assert env_backend.serve_decode_pin() is None
+
+
+def test_legacy_flag_warns_once(monkeypatch, caplog):
+    monkeypatch.setenv("MAGI_ATTENTION_FFA_FUSED_BWD", "1")
+    with caplog.at_level(logging.WARNING, "magiattention_tpu.env.backend"):
+        assert env_backend.ffa_bwd_pin() == "fused"
+        assert env_backend.ffa_bwd_pin() == "fused"
+    notices = [
+        r for r in caplog.records if "MAGI_ATTENTION_FFA_FUSED_BWD" in r.getMessage()
+    ]
+    assert len(notices) == 1
+    assert "MAGI_ATTENTION_BACKEND_FFA_BWD" in notices[0].getMessage()
+
+
+# -- provenance -------------------------------------------------------------
+
+
+def test_resolution_announces_backend_select(tmp_path, monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("MAGI_ATTENTION_BACKEND_STORE", "0")  # JSONL only
+
+    for _ in range(3):  # announce dedupes repeats of one (key, choice)
+        kreg.resolve("ffa_bwd", (1, 2), lambda: "fused")
+    telemetry.reset()  # flush
+
+    import json
+    records = []
+    for fp in sorted(tmp_path.glob("*.jsonl")):
+        with open(fp) as f:
+            records.extend(json.loads(line) for line in f if line.strip())
+    selects = [r for r in records if r["kind"] == "backend_select"]
+    assert len(selects) == 1
+    assert selects[0]["decision"] == "ffa_bwd"
+    assert selects[0]["choice"] == "fused"
+    assert selects[0]["source"] == "heuristic"
+    assert selects[0]["key"] == [1, 2]

@@ -1,0 +1,323 @@
+"""Persistent telemetry store (telemetry/store.py) + drift layer
+(telemetry/drift.py): round trips, compaction, concurrent appends, drift
+findings from seeded mispredictions, constant refitting, and calibrated
+consumption by the solvers (docs/observability.md)."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.kernels import registry as kreg
+from magiattention_tpu.telemetry import drift
+from magiattention_tpu.telemetry import store as tstore
+from magiattention_tpu.telemetry.store import StoreState, TelemetryStore
+
+from tests.test_support.script_loading import load_script
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPORT = os.path.join(REPO, "scripts", "telemetry_report.py")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observatory():
+    telemetry.reset()
+    tstore.reset()
+    kreg.reset_registry()
+    yield
+    telemetry.reset()
+    tstore.reset()
+    kreg.reset_registry()
+
+
+@pytest.fixture
+def active_store(tmp_path, monkeypatch):
+    """Telemetry + store on, pointed into tmp. Returns the store dir."""
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    store_dir = str(tmp_path / "store")
+    monkeypatch.setenv("MAGI_ATTENTION_STORE_DIR", store_dir)
+    return store_dir
+
+
+def test_store_round_trip(tmp_path):
+    """Rows written by one handle are aggregated identically by a fresh
+    handle reading the same directory (the cross-process contract)."""
+    d = str(tmp_path / "s")
+    st = TelemetryStore(d)
+    key = {"mask_sig": "m1", "mesh_sig": "cp4", "env_sig": "e1"}
+    st.record_measurement("calc_attn", key, "ffa", 10.0)
+    st.record_measurement("calc_attn", key, "ffa", 20.0)
+    st.record_measurement("calc_attn", key, "sdpa", 90.0, ok=False)
+    st.record_policy("ffa_bwd", (4, 256, 512), "fused", "heuristic")
+    st.record_history("attn_step", key, 12.5, steps=1)
+    st.record_observation("tile_score", 1000.0, 2.0, area=800.0, works=4.0)
+    st.record_calibration("overhead_elems", 3072.0, 7)
+    st.record_drift({"model": "tile_score", "rel_err": 0.9})
+    st.close()
+
+    other = TelemetryStore(d)
+    state = other.load()
+    assert other.best_backend("calc_attn", key) == ("ffa", 15.0)
+    # the not-ok sdpa row counts but never qualifies as measured-best
+    ekey = f"calc_attn|{tstore.canonical_key(key)}"
+    assert state.entries[ekey]["by_backend"]["sdpa"]["ok"] == 0
+    assert other.policy_for("ffa_bwd", (4, 256, 512)) is not None
+    assert other.policy_for("ffa_bwd", (4, 256, 512))["choice"] == "fused"
+    hkey = f"attn_step|{tstore.canonical_key(key)}"
+    assert state.history[hkey]["count"] == 1
+    assert state.history[hkey]["wall_ms_min"] == 12.5
+    assert state.observations["tile_score"][0]["extras"]["area"] == 800.0
+    assert other.calibration_for("overhead_elems") == 3072.0
+    assert state.drift[0]["model"] == "tile_score"
+    other.close()
+
+
+def test_history_lines_are_jsonl_and_writer_unique(tmp_path):
+    """Satellite 1: each writer gets its own history-<host>-<pid>-<token>
+    file, every line parses standalone (O_APPEND line-atomic sink)."""
+    d = str(tmp_path / "s")
+    a, b = TelemetryStore(d), TelemetryStore(d)
+    a.record_measurement("x", (1,), "one", 1.0)
+    b.record_measurement("x", (1,), "one", 2.0)
+    a.close()
+    b.close()
+    files = sorted(os.listdir(d))
+    assert len(files) == 2
+    for name in files:
+        assert name.startswith("history-") and name.endswith(".jsonl")
+        parts = name[len("history-"): -len(".jsonl")].rsplit("-", 2)
+        assert len(parts) == 3 and parts[1] == str(os.getpid())
+        with open(os.path.join(d, name)) as f:
+            rows = [json.loads(line) for line in f]
+        assert all(r["rk"] == "measure" and "ts" in r and "v" in r
+                   for r in rows)
+
+
+def test_compaction_folds_history_into_snapshot(tmp_path):
+    d = str(tmp_path / "s")
+    st = TelemetryStore(d)
+    for ms in (5.0, 7.0, 9.0):
+        st.record_measurement("calc_attn", ("k",), "ffa", ms)
+    snap = st.compact()
+    assert os.path.basename(snap) == "store.json"
+    # history files consumed; appends after compaction go to a fresh file
+    assert [f for f in os.listdir(d) if f.startswith("history-")] == []
+    st.record_measurement("calc_attn", ("k",), "ffa", 11.0)
+    st.close()
+
+    fresh = TelemetryStore(d)
+    best = fresh.best_backend("calc_attn", ("k",))
+    assert best is not None and best[0] == "ffa"
+    assert best[1] == pytest.approx((5.0 + 7.0 + 9.0 + 11.0) / 4)
+    fresh.close()
+
+
+def test_concurrent_appends_never_lose_rows(tmp_path):
+    """Many threads, each with its own handle on the same directory: the
+    merged view must contain every row (per-writer files + O_APPEND)."""
+    d = str(tmp_path / "s")
+    n_threads, n_rows = 8, 25
+
+    def writer(i):
+        st = TelemetryStore(d)
+        for j in range(n_rows):
+            st.record_measurement("calc_attn", ("shared",), f"b{i}", 1.0 + j)
+        st.close()
+
+    threads = [
+        threading.Thread(target=writer, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    st = TelemetryStore(d)
+    state = st.load()
+    entry = state.entries[f"calc_attn|{tstore.canonical_key(('shared',))}"]
+    assert entry["count"] == n_threads * n_rows
+    assert all(
+        entry["by_backend"][f"b{i}"]["count"] == n_rows
+        for i in range(n_threads)
+    )
+    st.close()
+
+
+def test_store_inactive_without_telemetry(tmp_path, monkeypatch):
+    monkeypatch.delenv("MAGI_ATTENTION_TELEMETRY", raising=False)
+    monkeypatch.setenv("MAGI_ATTENTION_STORE_DIR", str(tmp_path / "s"))
+    assert not tstore.store_active()
+    assert tstore.get_store() is None
+    tstore.record_measurement("calc_attn", ("k",), "ffa", 1.0)
+    tstore.record_observation("tile_score", 1.0, 1.0)
+    assert tstore.policy_lookup("calc_attn", ("k",)) is None
+    assert tstore.calibrated("overhead_elems", 42.0) == 42.0
+    assert not os.path.exists(str(tmp_path / "s"))
+
+
+def test_store_opt_out_flag(tmp_path, monkeypatch):
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("MAGI_ATTENTION_BACKEND_STORE", "0")
+    assert not tstore.store_active()
+    assert tstore.get_store() is None
+
+
+def test_ingest_attn_step_feeds_measurements_and_observations(active_store):
+    """An attn_step record ingests into run history, a calc_attn
+    measurement keyed by (mask, mesh, env) signature, and a tile_score
+    observation recomputed from its plan groups."""
+    payload = {
+        "backend": "ffa",
+        "wall_ms": 8.0,
+        "mask_sig": "mA", "mesh_sig": "cp4", "env_sig": "eA",
+        "q_shape": [128, 2, 32], "kv_shape": [128, 1, 32],
+        "dtype": "float32", "cp_size": 4,
+        "plan_groups": [
+            {"name": "merged", "block_q": 128, "block_k": 128,
+             "num_work": 4, "padded_elems": 4 * 128 * 128},
+        ],
+        "bwd_mode": "split",
+        "bwd_key": [4, 128, 128, 4, 128, 128, 32, 32, 4, 1],
+        "bwd_cost": 123456.0,
+    }
+    for _ in range(2):
+        telemetry.record_event("attn_step", **payload)
+    st = tstore.get_store()
+    state = st.load()
+    mkey = {"mask_sig": "mA", "mesh_sig": "cp4", "env_sig": "eA"}
+    assert st.best_backend("calc_attn", mkey) == ("ffa", 8.0)
+    hkeys = [k for k in state.history if k.startswith("attn_step|")]
+    assert len(hkeys) == 1 and state.history[hkeys[0]]["count"] == 2
+    obs = state.observations
+    assert len(obs["tile_score"]) == 2
+    assert obs["tile_score"][0]["extras"]["works"] == 4.0
+    assert len(obs["bwd_cost"]) == 2
+    assert obs["bwd_cost"][0]["predicted"] == 123456.0
+
+
+def test_drift_scan_flags_seeded_misprediction(active_store):
+    """Seed a cost model with consistent observations plus one gross
+    misprediction: scan must flag exactly the outlier and emit a
+    model_drift record that persists back into the store."""
+    # consistent: measured = 0.01 * predicted. The outlier's prediction is
+    # small so the consistent points dominate the global scale fit — only
+    # the outlier lands past threshold after scaling.
+    for pred in (10000.0, 20000.0, 30000.0):
+        tstore.record_observation("tile_score", pred, 0.01 * pred)
+    tstore.record_observation("tile_score", 1000.0, 100.0)
+
+    findings = drift.scan(threshold=0.5)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["model"] == "tile_score"
+    assert f["measured_ms"] == 100.0
+    assert f["rel_err"] > 0.5
+    assert f["alpha"] == pytest.approx(0.01, rel=0.01)
+
+    # the emitted model_drift event ingested back as a drift row
+    state = tstore.get_store().load()
+    assert any(d.get("model") == "tile_score" for d in state.drift)
+
+
+def test_drift_scan_quiet_when_model_tracks(active_store):
+    for pred in (1000.0, 2000.0, 3000.0, 4000.0):
+        tstore.record_observation("bwd_cost", pred, 0.02 * pred)
+    assert drift.scan(threshold=0.5) == []
+
+
+def test_fit_constants_recovers_planted_ratios(active_store):
+    """fit_constants must recover OVERHEAD = b/a from ms = a*(area +
+    OVERHEAD*works) observations, and dcn_per_row likewise."""
+    a, overhead = 0.001, 2048.0
+    rows = [(65536.0, 4.0), (131072.0, 16.0), (262144.0, 8.0),
+            (524288.0, 64.0)]
+    for area, works in rows:
+        tstore.record_observation(
+            "tile_score", area + overhead * works,
+            a * (area + overhead * works), area=area, works=works,
+        )
+    ici, dcn = 0.002, 9.0
+    for ici_rows, dcn_rows in ((4096.0, 512.0), (8192.0, 256.0),
+                               (2048.0, 2048.0)):
+        tstore.record_observation(
+            "two_level_makespan", ici_rows + 8.0 * dcn_rows,
+            ici * (ici_rows + dcn * dcn_rows),
+            ici_rows=ici_rows, dcn_rows=dcn_rows,
+        )
+    fitted = drift.fit_constants()
+    assert fitted["overhead_elems"] == pytest.approx(overhead, rel=1e-6)
+    assert fitted["dcn_per_row"] == pytest.approx(dcn, rel=1e-6)
+    # persisted as calib rows readable by the consumption hooks
+    assert tstore.calibrated("overhead_elems", 0.0) == pytest.approx(overhead)
+    assert tstore.calibrated("dcn_per_row", 0.0) == pytest.approx(dcn)
+
+
+def test_calibrated_constants_reach_the_solvers(active_store, monkeypatch):
+    from magiattention_tpu.kernels import tile_policy
+    from magiattention_tpu.meta.solver import overlap_solver
+
+    st = tstore.get_store()
+    st.record_calibration("overhead_elems", 5000.0, 5)
+    st.record_calibration("dcn_per_row", 12.5, 5)
+    assert tile_policy._overhead_elems() == 5000.0
+    assert overlap_solver._calibrated_dcn_per_row() == 12.5
+    # the opt-out flag restores the built-in constants bit-identically
+    monkeypatch.setenv("MAGI_ATTENTION_CALIBRATION", "0")
+    assert tile_policy._overhead_elems() == tile_policy.OVERHEAD_ELEMS
+    assert overlap_solver._calibrated_dcn_per_row() == overlap_solver.DCN_PER_ROW
+
+
+def test_report_round_trips_store_and_drift(active_store, tmp_path, capsys):
+    """Satellite 2 + acceptance: telemetry_report --json carries the
+    model_drift section (from the JSONL stream) and the store section
+    (from --store), both schema-documented."""
+    for pred in (10000.0, 20000.0, 30000.0):
+        tstore.record_observation("tile_score", pred, 0.01 * pred)
+    tstore.record_observation("tile_score", 1000.0, 100.0)
+    assert len(drift.scan(threshold=0.5)) == 1
+    telemetry.reset()  # flush the JSONL stream
+    tstore.reset()
+
+    mod = load_script(REPORT, "telemetry_report_store_test")
+    records = mod.load_records([str(tmp_path)])
+    agg = mod.aggregate(records)
+    md = agg["model_drift"]
+    assert md["findings"] == 1
+    assert md["by_model"]["tile_score"]["count"] == 1
+    assert md["worst"]["measured_ms"] == 100.0
+
+    store_dir = str(tmp_path / "store")
+    agg["store"] = mod.aggregate_store(store_dir)
+    assert agg["store"]["observations"]["tile_score"] == 4
+    assert agg["store"]["drift_rows"] == 1
+
+    # every emitted section is documented in SECTION_SCHEMAS
+    assert set(agg) <= set(mod.SECTION_SCHEMAS)
+    text = mod.format_summary(agg)
+    assert "model drift" in text and "store [" in text
+
+    # CLI: --store + --json round trip, and --schema self-documentation
+    assert mod.main(["--json", "--store", store_dir, str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["model_drift"]["findings"] == 1
+    assert out["store"]["drift_rows"] == 1
+    assert mod.main(["--schema"]) == 0
+    schema = json.loads(capsys.readouterr().out)
+    assert set(schema) == set(mod.SECTION_SCHEMAS)
+
+
+def test_compaction_preserves_registry_policy(active_store):
+    """Policy rows survive compaction: a warm restart after compact still
+    resolves with zero tuning decisions."""
+    kreg.resolve("ffa_bwd", (1, 2, 3), lambda: "fused")
+    tstore.get_store().compact()
+    kreg.reset_registry()
+    tstore.reset()
+    choice = kreg.resolve(
+        "ffa_bwd", (1, 2, 3), lambda: pytest.fail("re-tuned after compact")
+    )
+    assert choice.name == "fused" and choice.source == "policy"
