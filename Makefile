@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
 	telemetry-smoke analysis lint verify-plans kernel-audit chaos \
-	serve-smoke perf-gate
+	serve-smoke perf-gate nsa-needle-smoke
 
 test: analysis chaos serve-smoke  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -54,6 +54,9 @@ perf-gate:  ## fail on >10% bench regression vs prior run without a BENCH note
 
 chaos:  ## fault-injection chaos matrix: every site recovers or raises typed
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience -x -q -m chaos
+
+nsa-needle-smoke:  ## needle-in-haystack retrieval through the gather-free NSA kernel (CPU interpret)
+	JAX_PLATFORMS=cpu $(PY) examples/needle_1m.py --smoke
 
 serve-smoke:  ## CPU continuous-batching end-to-end: engine bitwise vs replay
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py

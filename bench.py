@@ -717,6 +717,147 @@ def run_bwd_suite() -> int:
 
 
 # ---------------------------------------------------------------------------
+# --nsa-suite: gathered vs gather-free NSA slc branch A/B
+# ---------------------------------------------------------------------------
+
+
+def _nsa_families(seq: int) -> dict:
+    """name -> cu_seqlens: the NSA A/B layouts. single_doc is the long-
+    context anchor; block_sparse_pretrain packs uneven causal documents
+    (the block-sparse pretraining mask family — segment boundaries force
+    per-segment block layouts and segment-masked top-k); many_docs packs
+    eight short documents (worst-case selection-table churn). All
+    boundaries stay on the d_stride grid so the gather-free kernel is
+    feasible for every family."""
+    a, b = seq // 4, 5 * seq // 8
+    return {
+        "single_doc": [0, seq],
+        "block_sparse_pretrain": [0, a, b, seq],
+        "many_docs": [seq * i // 8 for i in range(9)],
+    }
+
+
+def run_nsa_suite() -> int:
+    """Gathered vs gather-free NSA selected-block attention A/B.
+
+    Each (family, seq) runs the SAME nsa_attn forward under
+    MAGI_ATTENTION_BACKEND_NSA_SLC=gathered_dense and =block_sparse_pallas
+    (the pin bypasses the registry memo, so the flip takes effect per
+    call). Rows carry the modeled HBM story from modeled_slc_bytes —
+    streamed_bytes (what the kernel moves) vs gathered_bytes (stream +
+    materialized top-k copy) — alongside measured wall time, with the
+    credibility floor computed from the slc branch's own executed matmul
+    flops (4 * S * top_k * l_slc * D * HQ: a slope beating that physics
+    is an under-cancelled pair, not a win). Rows append to
+    benchmarks/history/bench_nsa.csv; off-TPU the suite runs end-to-end
+    on a tiny shape (chained timing, no floor) so the harness stays
+    CI-covered and the perf gate sees its pass-with-note first row."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from magiattention_tpu.benchmarking.bench import do_bench_scan_slope
+    from magiattention_tpu.benchmarking.perf_report import credible_floor_ms
+    from magiattention_tpu.kernels.block_sparse import modeled_slc_bytes
+    from magiattention_tpu.parallel.nsa import init_nsa_params, nsa_attn
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    seqs = (8192, 32768) if on_tpu else (1024,)
+    HQ, HK, D = (16, 8, 128) if on_tpu else (4, 2, 64)
+    L_CMP, L_SLC, D_STRIDE, BQ = 32, 64, 32, 16
+    TOP_K = 8 if on_tpu else 2
+    WINDOW = (128, 0) if on_tpu else (64, 0)
+    dtype = jnp.bfloat16
+
+    PINS = (
+        ("gathered_dense", "gathered_dense"),
+        ("gather_free", "block_sparse_pallas"),
+    )
+
+    rows = []
+    for seq in seqs:
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((seq, HQ, D)), dtype)
+        k = jnp.asarray(rng.standard_normal((seq, HK, D)), dtype)
+        v = jnp.asarray(rng.standard_normal((seq, HK, D)), dtype)
+        params = init_nsa_params(jax.random.PRNGKey(0), D, L_CMP)
+        n_qb = seq // BQ
+        slc_bytes = modeled_slc_bytes(
+            hk=HK, n_qb=n_qb, top_k=TOP_K, block_len=L_SLC,
+            d_stride=D_STRIDE, block_size_q=BQ, g=HQ // HK, d=D, dv=D,
+            itemsize=jnp.dtype(dtype).itemsize,
+        )
+        for name, cu in _nsa_families(seq).items():
+            pair = {}
+            for mode, pin in PINS:
+                saved = os.environ.get("MAGI_ATTENTION_BACKEND_NSA_SLC")
+                os.environ["MAGI_ATTENTION_BACKEND_NSA_SLC"] = pin
+                row = {
+                    "family": name, "seq": seq, "mode": mode,
+                    "backend": backend, "top_k": TOP_K, "l_slc": L_SLC,
+                    "d_stride": D_STRIDE,
+                    "slc_streamed_bytes": slc_bytes["streamed_bytes"],
+                    "slc_gathered_bytes": slc_bytes["gathered_bytes"],
+                }
+                # slc-branch executed matmul flops: the floor for THIS A/B
+                exec_flops = 4 * seq * TOP_K * L_SLC * D * HQ
+                try:
+                    def body(q):
+                        return nsa_attn(
+                            q, k, v, params, cu, l_cmp=L_CMP, l_slc=L_SLC,
+                            d_stride=D_STRIDE, block_size_q=BQ,
+                            slc_top_k=TOP_K, window=WINDOW,
+                        ).astype(dtype)
+
+                    if on_tpu:
+                        floor = credible_floor_ms(exec_flops)
+                        ms = do_bench_scan_slope(
+                            body, q, lengths=(8, 32), reps=2,
+                            min_credible_ms=floor,
+                        )
+                        row["floor_ms"] = round(floor, 3)
+                        row["timing_mode"] = "scan_slope"
+                    else:
+                        import time as _time
+
+                        step = jax.jit(body)
+                        step(q).block_until_ready()  # compile
+                        t0 = _time.perf_counter()
+                        step(q).block_until_ready()
+                        ms = (_time.perf_counter() - t0) * 1e3
+                        row["timing_mode"] = "chained_cpu"
+                    row["ms"] = round(ms, 3)
+                    pair[mode] = ms
+                except Exception as e:  # noqa: BLE001
+                    row["error"] = f"{type(e).__name__}: {e}"[:200]
+                finally:
+                    if saved is None:
+                        os.environ.pop(
+                            "MAGI_ATTENTION_BACKEND_NSA_SLC", None
+                        )
+                    else:
+                        os.environ["MAGI_ATTENTION_BACKEND_NSA_SLC"] = saved
+                rows.append(row)
+            if "gathered_dense" in pair and pair.get("gather_free"):
+                rows[-1]["gather_free_speedup"] = round(
+                    pair["gathered_dense"] / pair["gather_free"], 3
+                )
+
+    try:
+        from magiattention_tpu.benchmarking.perf_report import append_row
+
+        for row in rows:
+            append_row("bench_nsa", row)
+    except Exception:
+        pass
+    return _emit(
+        {"metric": "nsa_suite", "backend": backend, "rows": rows}
+    )
+
+
+# ---------------------------------------------------------------------------
 # --dcn-suite: flat vs two-level (DCN x ICI) comm-plan A/B (CPU-safe)
 # ---------------------------------------------------------------------------
 
@@ -886,6 +1027,8 @@ if __name__ == "__main__":
         sys.exit(run_sparse_suite())
     if "--bwd-suite" in sys.argv:
         sys.exit(run_bwd_suite())
+    if "--nsa-suite" in sys.argv:
+        sys.exit(run_nsa_suite())
     if "--dcn-suite" in sys.argv:
         sys.exit(run_dcn_suite())
     sys.exit(run_worker() if "--worker" in sys.argv else main())

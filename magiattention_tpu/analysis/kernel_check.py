@@ -560,6 +560,101 @@ def capture_decode_contracts(spec: DecodeAuditSpec) -> list[KernelContract]:
     return cap.contracts
 
 
+@dataclass(frozen=True, eq=False)
+class BlockSparseAuditSpec:
+    """One block-sparse NSA-slc corpus config (kernels/block_sparse.py)."""
+
+    name: str
+    seq: int = 512
+    hq: int = 4
+    hk: int = 2
+    d: int = 128
+    dv: int = 128
+    block_len: int = 64
+    d_stride: int = 32
+    block_size_q: int = 16
+    top_k: int = 2
+    dtype: str = "bfloat16"
+
+
+def bsp_corpus() -> list[BlockSparseAuditSpec]:
+    """Configs the block-sparse kernels are captured at: the NSA default
+    (overlapping stride-32 blocks, GQA g=2), a non-overlapping fp32 g=1
+    variant, and a wider-group bf16 config whose deterministic table picks
+    adjacent blocks (maximal chunk duplication across the revisit axis)."""
+    return [
+        BlockSparseAuditSpec(name="bsp/bfloat16/g2/overlap"),
+        BlockSparseAuditSpec(
+            name="bsp/float32/g1/aligned", dtype="float32", hq=2,
+            block_len=64, d_stride=64, top_k=3,
+        ),
+        BlockSparseAuditSpec(
+            name="bsp/bfloat16/g4/adjacent", hq=8, seq=256, top_k=4,
+        ),
+    ]
+
+
+def capture_bsp_contracts(spec: BlockSparseAuditSpec) -> list[KernelContract]:
+    """Drive BOTH block-sparse wrappers (fwd + fused bwd) under capture at
+    ``spec`` with a deterministic adjacent-block index table — the shape the
+    NSA top-k emits, including overlapping picks when d_stride < block_len."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import block_sparse
+
+    S, ds = spec.seq, spec.d_stride
+    n_blocks = (S - spec.block_len) // ds + 1
+    n_qb = S // spec.block_size_q
+    n_chunks = S // ds
+    alpha = spec.block_len // ds
+    g = spec.hq // spec.hk
+    r = spec.block_size_q * g
+    dtype = jnp.dtype(spec.dtype)
+
+    # adjacent distinct block ids per (head, q-block), wrapped in range
+    idx = (
+        np.arange(spec.top_k)[None, None, :]
+        + np.arange(n_qb)[None, :, None]
+        + np.arange(spec.hk)[:, None, None]
+    ) % n_blocks
+    starts = np.arange(n_blocks, dtype=np.int32) * ds
+    ctbl = jnp.asarray(
+        ((starts // ds)[idx][..., None] + np.arange(alpha))
+        .reshape(spec.hk, n_qb, -1),
+        jnp.int32,
+    )
+    C = spec.top_k * alpha
+
+    q_r = jnp.zeros((spec.hk, n_qb, r, spec.d), dtype)
+    k_c = jnp.zeros((n_chunks, ds, spec.hk, spec.d), dtype)
+    v_c = jnp.zeros((n_chunks, ds, spec.hk, spec.dv), dtype)
+    do_r = jnp.zeros((spec.hk, n_qb, r, spec.dv), dtype)
+    lse_r = jnp.zeros((spec.hk, n_qb, r, 128), jnp.float32)
+    delta_r = jnp.zeros((spec.hk, n_qb, r, 128), jnp.float32)
+    scale = float(spec.d) ** -0.5
+
+    contracts: list[KernelContract] = []
+    with jax.default_device(jax.devices("cpu")[0]):
+        for drive in (
+            lambda: block_sparse._bsp_fwd_pallas(
+                ctbl, q_r, k_c, v_c, scale, True
+            ),
+            lambda: block_sparse._bsp_bwd_pallas(
+                ctbl, q_r, k_c, v_c, do_r, lse_r, delta_r, scale, True
+            ),
+        ):
+            cap = _capture_pallas()
+            with cap:
+                try:
+                    drive()
+                except _Captured:
+                    pass
+            contracts.extend(cap.contracts)
+    assert all(c.grid == (spec.hk, n_qb, C) for c in contracts)
+    return contracts
+
+
 # ---------------------------------------------------------------------------
 # contract geometry helpers
 # ---------------------------------------------------------------------------
@@ -589,6 +684,22 @@ def _contract_shape_info(contract: KernelContract) -> dict:
         v_block = contract.in_specs[2].block_shape
         return dict(
             kind="decode", packed=False, g=1,
+            bq=int(q_block[2]), bk=int(k_block[1]),
+            d=int(q_block[3]), dv=int(v_block[3]),
+            itemsize=np.dtype(contract.operands[0][1]).itemsize,
+            emit_ml=False,
+        )
+    if "bsp" in name:
+        # block-sparse kernels (kernels/block_sparse.py): q block
+        # (1, 1, r, d) with r = block_size_q * group rows, k/v blocks
+        # (1, d_stride, 1, d|dv); bq = r, bk = chunk rows. Checked BEFORE
+        # the generic branch — "_bsp_fwd_kernel" also contains "fwd".
+        q_block = contract.in_specs[0].block_shape
+        k_block = contract.in_specs[1].block_shape
+        v_block = contract.in_specs[2].block_shape
+        return dict(
+            kind="bsp_bwd" if "bwd" in name else "bsp_fwd",
+            packed=False, g=1,
             bq=int(q_block[2]), bk=int(k_block[1]),
             d=int(q_block[3]), dv=int(v_block[3]),
             itemsize=np.dtype(contract.operands[0][1]).itemsize,
@@ -977,15 +1088,17 @@ def check_k4_dtypes(
 
 
 def _pallas_contracts() -> dict:
+    from ..kernels.block_sparse import PALLAS_CONTRACTS as bsp_contracts
     from ..kernels.ffa import PALLAS_CONTRACTS as ffa_contracts
     from ..kernels.paged_decode import PALLAS_CONTRACTS as decode_contracts
 
-    return {**ffa_contracts, **decode_contracts}
+    return {**ffa_contracts, **decode_contracts, **bsp_contracts}
 
 
 def _contract_sources() -> list[tuple[str, str, dict]]:
     """(relpath, source, contracts) for every kernel module that declares
     PALLAS_CONTRACTS — the K2/K4 source-rule sweep iterates these."""
+    from ..kernels.block_sparse import PALLAS_CONTRACTS as bsp_contracts
     from ..kernels.ffa import PALLAS_CONTRACTS as ffa_contracts
     from ..kernels.paged_decode import PALLAS_CONTRACTS as decode_contracts
 
@@ -996,6 +1109,11 @@ def _contract_sources() -> list[tuple[str, str, dict]]:
             "kernels/paged_decode.py",
             (kdir / "paged_decode.py").read_text(),
             decode_contracts,
+        ),
+        (
+            "kernels/block_sparse.py",
+            (kdir / "block_sparse.py").read_text(),
+            bsp_contracts,
         ),
     ]
 
@@ -1137,7 +1255,15 @@ def _check_kernel_sources_one(
         init_guard = decl["init_guard"]
         flush_guard = decl["flush_guard"]
         group = decl.get("group_inner")
+        # revisit: one dict or a list of dicts, one per revisit-accumulated
+        # output. Each may override the guard-binding substrings
+        # (init_binding / flush_binding, defaults QVF / QVL for the plan-
+        # meta kernels) and may declare flush_guard=None for outputs whose
+        # accumulated value is final as-is (host-side correction only)
         revisit = decl.get("revisit")
+        revisits = (
+            [revisit] if isinstance(revisit, dict) else list(revisit or [])
+        )
 
         if init_guard is None and flush_guard is None:
             # stateless map kernel (e.g. the delta kernel): no cross-step
@@ -1168,11 +1294,14 @@ def _check_kernel_sources_one(
             (init_guard, decl.get("init_binding", "IS_FIRST")),
             (flush_guard, decl.get("flush_binding", "IS_LAST")),
         ]
-        if revisit:
-            guard_cols += [
-                (revisit["init_guard"], "QVF"),
-                (revisit["flush_guard"], "QVL"),
-            ]
+        for rv in revisits:
+            guard_cols.append(
+                (rv["init_guard"], rv.get("init_binding", "QVF"))
+            )
+            if rv.get("flush_guard") is not None:
+                guard_cols.append(
+                    (rv["flush_guard"], rv.get("flush_binding", "QVL"))
+                )
         for var, col in guard_cols:
             if col not in bindings.get(var, ""):
                 report.add(
@@ -1236,9 +1365,9 @@ def _check_kernel_sources_one(
 
         # outputs: stored exactly once, only under the flush guard
         # (a revisit-accumulated output follows its own discipline below)
+        revisit_outs = {rv["out"] for rv in revisits}
         outputs = tuple(
-            n for n in decl["outputs"]
-            if not revisit or n != revisit["out"]
+            n for n in decl["outputs"] if n not in revisit_outs
         )
         flush_assigns: dict[str, int] = {n: 0 for n in outputs}
         flush_nodes: set[int] = set()
@@ -1271,16 +1400,17 @@ def _check_kernel_sources_one(
                     f"times — the contract requires exactly one flush",
                 )
 
-        # revisit-accumulated output: the k-major traversal revisits the
-        # same output block across work items, so the kernel must (a)
-        # zero it on the q tile's FIRST visit — on hardware the window's
-        # initial VMEM content is undefined; interpret mode hides this —
-        # (b) flush exactly once on the LAST visit, and (c) only ever
-        # accumulate (+=) in between, never overwrite
-        if revisit:
-            rout = revisit["out"]
-            rvf = revisit["init_guard"]
-            rvl = revisit["flush_guard"]
+        # revisit-accumulated outputs: the traversal revisits the same
+        # output block across work items, so the kernel must (a) zero it
+        # on the FIRST visit — on hardware the window's initial VMEM
+        # content is undefined; interpret mode hides this — (b) when a
+        # last-visit correction is declared (flush_guard not None), flush
+        # exactly once on the LAST visit, and (c) only ever accumulate
+        # (+=) in between, never overwrite
+        for rv in revisits:
+            rout = rv["out"]
+            rvf = rv["init_guard"]
+            rvl = rv.get("flush_guard")
             r_init_ids: set[int] = set()
             has_init = False
             for conds, node in blocks:
@@ -1305,26 +1435,27 @@ def _check_kernel_sources_one(
                     f"garbage",
                 )
             r_flush_ids: set[int] = set()
-            n_flush = 0
-            for conds, node in blocks:
-                if (rvl, "1") not in conds:
-                    continue
-                assigns = _subscript_stores(node, (rout,))[rout]
-                n_flush += len(assigns)
-                r_flush_ids.update(id(a) for a in assigns)
-            if n_flush == 0:
-                report.add(
-                    "K2", ERROR, site,
-                    f"revisit-accumulated output '{rout}' is never "
-                    f"flushed under the {rvl} (last-visit) guard",
-                )
-            elif n_flush > 1:
-                report.add(
-                    "K2", ERROR, site,
-                    f"revisit-accumulated output '{rout}' is flushed "
-                    f"{n_flush} times — the contract requires exactly "
-                    f"one last-visit flush",
-                )
+            if rvl is not None:
+                n_flush = 0
+                for conds, node in blocks:
+                    if (rvl, "1") not in conds:
+                        continue
+                    assigns = _subscript_stores(node, (rout,))[rout]
+                    n_flush += len(assigns)
+                    r_flush_ids.update(id(a) for a in assigns)
+                if n_flush == 0:
+                    report.add(
+                        "K2", ERROR, site,
+                        f"revisit-accumulated output '{rout}' is never "
+                        f"flushed under the {rvl} (last-visit) guard",
+                    )
+                elif n_flush > 1:
+                    report.add(
+                        "K2", ERROR, site,
+                        f"revisit-accumulated output '{rout}' is flushed "
+                        f"{n_flush} times — the contract requires exactly "
+                        f"one last-visit flush",
+                    )
             for a in _subscript_stores(fn, (rout,))[rout]:
                 if id(a) in r_init_ids or id(a) in r_flush_ids:
                     continue
@@ -1657,6 +1788,28 @@ def run_kernel_audit(
                 }
             )
 
+    # block-sparse corpus: like decode, no plan metadata — the chunk grid
+    # is exactly the top-k selection, dense by construction
+    for bspec in bsp_corpus():
+        for contract in capture_bsp_contracts(bspec):
+            captured_kernels.add(contract.kernel_name)
+            site = f"{bspec.name}:{contract.kernel_name}"
+            check_contract(report, contract, site)
+            info = _contract_shape_info(contract)
+            rows.append(
+                {
+                    "config": bspec.name,
+                    "kernel": contract.kernel_name,
+                    "grid": list(contract.grid),
+                    "vmem_bytes": _declared_bytes(contract),
+                    "vmem_total_bytes": ffa_kernel_residency(
+                        info["kind"], info["bq"], info["bk"], info["d"],
+                        head_dim_v=info["dv"], dtype_bytes=info["itemsize"],
+                    ),
+                    "vmem_allowed_bytes": VMEM_ALLOWED_BYTES,
+                }
+            )
+
     site_kernels = {
         s.kernel_name for s in sites if s.kernel_name in declared
     }
@@ -1885,6 +2038,22 @@ def run_seeded_mutations() -> list[dict]:
         )
         check_contract(report, mut, "mutation:oob_page_table")
 
+    def oob_block_table(report: VerifyReport) -> None:
+        # point one chunk-table entry one past the last chunk: the block-
+        # sparse index maps consume the table UNclamped (the public wrapper
+        # audits concrete tables, but a traced top-k bypasses that), so
+        # only the K3 index-map bounds eval over the real prefetch catches
+        # the out-of-range stream
+        bbase = next(
+            c for c in capture_bsp_contracts(bsp_corpus()[0])
+            if c.kernel_name == "_bsp_fwd_kernel"
+        )
+        n_chunks = bbase.operands[1][0][0]  # k_c chunk axis
+        table = bbase.prefetch[0].copy()
+        table[0, 0, 0] = n_chunks
+        mut = replace(bbase, prefetch=(table,) + tuple(bbase.prefetch[1:]))
+        check_contract(report, mut, "mutation:oob_block_table")
+
     run("oversized_scratch", "K1", oversized)
     run("swapped_index_map_axes", "K3", swapped)
     run("missing_accumulator_init", "K2", no_init)
@@ -1893,4 +2062,5 @@ def run_seeded_mutations() -> list[dict]:
     run("unlisted_env_key", "K5", unlisted_key)
     run("corrupted_extent_row", "K3", bad_extent)
     run("oob_page_table", "K3", oob_page_table)
+    run("oob_block_table", "K3", oob_block_table)
     return results

@@ -126,6 +126,16 @@ def serve_decode_pin() -> str | None:
     return None
 
 
+def nsa_slc_pin() -> str | None:
+    """Pin for the NSA selected-block branch: 'block_sparse_pallas' |
+    'gathered_dense' | None. New decision, so no legacy flag exists —
+    MAGI_ATTENTION_BACKEND_NSA_SLC is the only key."""
+    val = _get_str("MAGI_ATTENTION_BACKEND_NSA_SLC", "").lower()
+    if val in ("block_sparse_pallas", "gathered_dense"):
+        return val
+    return None
+
+
 def backend_store_mode() -> str:
     """Persistent policy/measurement store mode: auto | 1 | 0.
 

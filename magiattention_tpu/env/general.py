@@ -204,6 +204,7 @@ ENV_KEYS_AFFECTING_RUNTIME: tuple[str, ...] = (
     # shared across flips of any of them
     "MAGI_ATTENTION_BACKEND_FFA_BWD",
     "MAGI_ATTENTION_BACKEND_MIXED_BLOCKS",
+    "MAGI_ATTENTION_BACKEND_NSA_SLC",
     "MAGI_ATTENTION_BACKEND_STORE",
     "MAGI_ATTENTION_CALIBRATION",
     # wire-tier selection changes the traced collective program

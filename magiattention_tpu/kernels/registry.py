@@ -271,6 +271,16 @@ def calc_attn_backend(key: Any = ()) -> str:
     ).name
 
 
+def nsa_slc_backend(key: Any = ()) -> str:
+    """The NSA selected-block branch for a shape: explicit
+    MAGI_ATTENTION_BACKEND_NSA_SLC pins it; otherwise the policy cache /
+    measured history / the gather-free kernel default decide."""
+    return resolve(
+        "nsa_slc", key, lambda: "block_sparse_pallas",
+        pin=env_backend.nsa_slc_pin(),
+    ).name
+
+
 def tiles_pinned() -> bool:
     """Explicit FFA block settings present (env FFA_BLOCK_Q/K): auto-tile
     and mixed dispatch must stand down — explicit settings always win."""
@@ -342,6 +352,12 @@ register_backend(
     "serve_decode", "gather_ffa", 1, "per-slot gather+FFA reference")
 register_backend(
     "serve_decode", "dense", 2, "dense jnp softmax — last resort")
+register_backend(
+    "nsa_slc", "block_sparse_pallas", 0,
+    "gather-free Pallas block-sparse slc kernel")
+register_backend(
+    "nsa_slc", "gathered_dense", 1,
+    "take_along_axis + dense softmax reference")
 
 # which env keys pin each decision (new BACKEND_* key first, legacy key
 # second) — provenance for reports and docs/env_variables.md
@@ -359,4 +375,5 @@ PIN_KEYS: dict[str, tuple[str, ...]] = {
     "ffa_bwd_dq": ("MAGI_ATTENTION_FFA_GQA_PACK_DQ",),
     "ffa_bwd_dkv": ("MAGI_ATTENTION_FFA_GQA_PACK_DKV",),
     "ffa_lowering": ("MAGI_ATTENTION_FFA_EXTENT_CLAMP",),
+    "nsa_slc": ("MAGI_ATTENTION_BACKEND_NSA_SLC",),
 }

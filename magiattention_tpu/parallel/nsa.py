@@ -30,6 +30,8 @@ import numpy as np
 from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import telemetry
+from ..kernels.block_sparse import block_sparse_attn, modeled_slc_bytes
 from ..kernels.ffa import ffa_attn
 from ..kernels.mask_utils import BAND_INF
 
@@ -85,12 +87,15 @@ def _p_slc_matrix(
     M = np.zeros((n_cmp, n_slc), dtype=np.float32)
     co = so = 0
     for nc, ns in zip(counts_cmp, counts_slc):
-        for j in range(ns):
-            for m in range(alpha):
-                for n in range(beta):
-                    idx = alpha * j - m - n
-                    if 0 <= idx < nc:
-                        M[co + idx, so + j] += 1.0
+        # cmp block i feeds slc block j once per (m, n) pair with
+        # m + n == alpha*j - i, m < alpha, n < beta: a small-integer count
+        # (exact in f32, so broadcast == the accumulation loop bitwise)
+        o = alpha * np.arange(ns)[None, :] - np.arange(nc)[:, None]
+        cnt = np.minimum(o, alpha - 1) - np.maximum(0, o - beta + 1) + 1
+        in_range = (o >= 0) & (o <= alpha + beta - 2)
+        M[co:co + nc, so:so + ns] = np.where(in_range, cnt, 0).astype(
+            np.float32
+        )
         co += nc
         so += ns
     return M
@@ -182,34 +187,78 @@ def nsa_attn(
     score = jnp.where(qb_mask[None], score, NEG_INF)
     _, idx = jax.lax.top_k(score, slc_top_k)  # (hk, n_qb, K)
 
-    # ---- slc branch: gather top-k blocks per (kv head, q block) ----------
-    k_slc_blk = (
-        k_cmp_blk if l_slc == l_cmp else blocks_of(k, slc_starts, l_slc)
-    )  # (n_slc, l, hk, dh)
-    v_slc_blk = (
-        v_cmp_blk if l_slc == l_cmp else blocks_of(v, slc_starts, l_slc)
+    # ---- slc branch: registry decision — gather-free block-sparse kernel
+    # (kernels/block_sparse.py streams the selected blocks through the
+    # prefetched index table) vs the gathered-dense reference ---------------
+    slc_feasible = (
+        S % d_stride == 0
+        and l_slc % d_stride == 0
+        and (d_stride <= 128 or d_stride % 128 == 0)
+        and not (slc_starts % d_stride).any()
     )
-    # (hk, n_qb, K, l, dh)
-    k_sel = jnp.take_along_axis(
-        k_slc_blk.transpose(2, 0, 1, 3)[:, None],  # (hk, 1, n_slc, l, dh)
-        idx[..., None, None],
-        axis=2,
-    )
-    v_sel = jnp.take_along_axis(
-        v_slc_blk.transpose(2, 0, 1, 3)[:, None], idx[..., None, None], axis=2
-    )
-    L = slc_top_k * k_sel.shape[-2]
-    k_sel = k_sel.reshape(hk, n_qb, L, dh)
-    v_sel = v_sel.reshape(hk, n_qb, L, dh)
-    qb = q.reshape(n_qb, block_size_q, hk, g, dh)
-    s_logits = (
-        jnp.einsum("bqhgd,hbld->hbgql", qb, k_sel).astype(jnp.float32) * scale
-    )
-    p_s = jax.nn.softmax(s_logits, axis=-1)
-    out_slc = (
-        jnp.einsum("hbgql,hbld->bqhgd", p_s.astype(q.dtype), v_sel)
-        .reshape(S, hq, dh)
-    )
+    if slc_feasible:
+        from ..kernels import registry as _registry
+
+        slc_backend = _registry.nsa_slc_backend(
+            key=(hk, g, n_qb, slc_top_k, l_slc, d_stride)
+        )
+    else:
+        slc_backend = "gathered_dense"
+    if slc_backend == "block_sparse_pallas":
+        out_slc, _ = block_sparse_attn(
+            q, k, v, idx, slc_starts,
+            block_len=l_slc, d_stride=d_stride,
+            block_size_q=block_size_q, softmax_scale=scale,
+        )
+    else:
+        # gathered-dense reference: materialize the top-k blocks, dense
+        # softmax over the concatenated selection
+        k_slc_blk = (
+            k_cmp_blk if l_slc == l_cmp else blocks_of(k, slc_starts, l_slc)
+        )  # (n_slc, l, hk, dh)
+        v_slc_blk = (
+            v_cmp_blk if l_slc == l_cmp else blocks_of(v, slc_starts, l_slc)
+        )
+        # (hk, n_qb, K, l, dh)
+        k_sel = jnp.take_along_axis(
+            k_slc_blk.transpose(2, 0, 1, 3)[:, None],  # (hk, 1, n_slc, l, dh)
+            idx[..., None, None],
+            axis=2,
+        )
+        v_sel = jnp.take_along_axis(
+            v_slc_blk.transpose(2, 0, 1, 3)[:, None], idx[..., None, None],
+            axis=2,
+        )
+        L = slc_top_k * k_sel.shape[-2]
+        k_sel = k_sel.reshape(hk, n_qb, L, dh)
+        v_sel = v_sel.reshape(hk, n_qb, L, dh)
+        qb = q.reshape(n_qb, block_size_q, hk, g, dh)
+        s_logits = (
+            jnp.einsum("bqhgd,hbld->hbgql", qb, k_sel).astype(jnp.float32)
+            * scale
+        )
+        p_s = jax.nn.softmax(s_logits, axis=-1)
+        out_slc = (
+            jnp.einsum("hbgql,hbld->bqhgd", p_s.astype(q.dtype), v_sel)
+            .reshape(S, hq, dh)
+        )
+    if telemetry.enabled():
+        slc_bytes = modeled_slc_bytes(
+            hk=hk, n_qb=n_qb, top_k=slc_top_k, block_len=l_slc,
+            d_stride=d_stride, block_size_q=block_size_q, g=g, d=dh,
+            dv=dh, itemsize=q.dtype.itemsize,
+        )
+        telemetry.record_event(
+            "nsa_step",
+            slc_backend=slc_backend,
+            top_k=slc_top_k,
+            hk=hk,
+            n_qb=n_qb,
+            l_slc=l_slc,
+            d_stride=d_stride,
+            executed_bytes=slc_bytes["streamed_bytes"],
+            gathered_bytes=slc_bytes["gathered_bytes"],
+        )
 
     # ---- win branch: banded FFA per segment (ref flash varlen + window) --
     wl, wr = window

@@ -88,10 +88,12 @@ def ffa_kernel_residency(
     unpacked kernels are per-q-head, so ``group`` is ignored for them
     except dkv's lse/delta sublane layout which is group-independent.
     """
-    if kind not in ("fwd", "dq", "dkv", "fused", "delta", "decode"):
+    if kind not in (
+        "fwd", "dq", "dkv", "fused", "delta", "decode", "bsp_fwd", "bsp_bwd"
+    ):
         raise ValueError(
-            f"kind must be 'fwd'|'dq'|'dkv'|'fused'|'delta'|'decode', "
-            f"got {kind!r}"
+            f"kind must be 'fwd'|'dq'|'dkv'|'fused'|'delta'|'decode'|"
+            f"'bsp_fwd'|'bsp_bwd', got {kind!r}"
         )
     dv = head_dim_v or head_dim
     g = group if packed else 1
@@ -142,15 +144,31 @@ def ffa_kernel_residency(
         blocks += bq * 128 * f32  # delta (lanes-broadcast)
         scratch = 0
         inter = bq * dv * f32  # fp32 elementwise product
-    else:  # decode (kernels/paged_decode.py): bq = GQA group rows of one
-        # kv head, bk = page_size; same fwd residency shape minus GQA
-        # packing (group/packed/emit_ml are ignored)
+    elif kind in ("decode", "bsp_fwd"):
+        # decode (kernels/paged_decode.py): bq = GQA group rows of one kv
+        # head, bk = page_size. bsp_fwd (kernels/block_sparse.py): bq =
+        # block_size_q * group rows of one q block, bk = d_stride chunk
+        # rows. Identical residency shape: q tile, one streamed k/v chunk,
+        # out + lanes-broadcast lse, m/l/acc scratch (group/packed/emit_ml
+        # are ignored).
         blocks = bq * d * dtype_bytes  # q group tile
         blocks += bk * d * dtype_bytes + bk * dv * dtype_bytes  # one k/v page
         blocks += bq * dv * dtype_bytes  # out
         blocks += bq * 128 * f32  # lse (lanes-broadcast)
         scratch = (2 * bq * 128 + bq * dv) * f32  # m, l, acc
         inter = bq * bk * f32  # s (p reuses its storage)
+    else:  # bsp_bwd (kernels/block_sparse.py fused backward): q/do tiles,
+        # one streamed k/v chunk, lanes-broadcast lse + delta, fp32 dq out
+        # plus revisit-accumulated dk/dv output windows with their aliased
+        # zero-background input blocks, dq fp32 scratch
+        blocks = bq * d * dtype_bytes  # q tile
+        blocks += bk * d * dtype_bytes + bk * dv * dtype_bytes  # k/v chunk
+        blocks += bq * dv * dtype_bytes  # do
+        blocks += 2 * bq * 128 * f32  # lse + delta (lanes-broadcast)
+        blocks += bq * d * f32  # dq out (fp32)
+        blocks += 2 * (bk * d + bk * dv) * f32  # dk/dv outs + dkz/dvz ins
+        scratch = bq * d * f32  # dq accumulator
+        inter = 2 * bq * bk * f32  # s + dp
     total = 2 * blocks + scratch
     if include_intermediates:
         total += inter

@@ -75,12 +75,19 @@ def _has_waiver(row: dict) -> bool:
     )
 
 
-def gate_file(path: str, threshold: float) -> list[dict]:
-    """Regression findings for one CSV (empty = clean)."""
+def gate_file(path: str, threshold: float) -> tuple[list[dict], list[str]]:
+    """(regression findings, informational notes) for one CSV.
+
+    A history with fewer than two rows cannot regress — freshly opened
+    bench trajectories (e.g. the first ``--nsa-suite`` run) pass with an
+    explicit note instead of erroring or passing silently."""
     with open(path, newline="") as f:
         rows = list(csv.DictReader(f))
     if len(rows) < 2:
-        return []
+        return [], [
+            f"{os.path.basename(path)}: {len(rows)} row(s) — nothing to "
+            f"compare yet, pass-with-note"
+        ]
     # a column is a metric only if its name matches AND it parses numeric
     # somewhere — 'timing_mode' stays config despite containing 'time'
     metrics: dict[str, str] = {}
@@ -123,7 +130,7 @@ def gate_file(path: str, threshold: float) -> list[dict]:
                 "new_commit": new.get("commit"),
                 "waived": waived,
             })
-    return findings
+    return findings, []
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -148,8 +155,11 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     findings: list[dict] = []
+    notes: list[str] = []
     for path in paths:
-        findings.extend(gate_file(path, args.threshold))
+        file_findings, file_notes = gate_file(path, args.threshold)
+        findings.extend(file_findings)
+        notes.extend(file_notes)
     blocking = [f for f in findings if not f["waived"]]
 
     if args.json:
@@ -157,14 +167,17 @@ def main(argv: list[str] | None = None) -> int:
             "files": len(paths),
             "threshold": args.threshold,
             "findings": findings,
+            "notes": notes,
             "blocking": len(blocking),
         }, indent=2))
     else:
         print(
             f"perf gate: {len(paths)} file(s), threshold "
             f"{args.threshold:.0%}, {len(findings)} regression(s), "
-            f"{len(blocking)} blocking"
+            f"{len(blocking)} blocking, {len(notes)} note(s)"
         )
+        for note in notes:
+            print(f"  [NOTE] {note}")
         for f in findings:
             cfg = " ".join(f"{k}={v}" for k, v in f["config"].items() if v)
             tag = "WAIVED" if f["waived"] else "FAIL"

@@ -149,6 +149,19 @@ SECTION_SCHEMAS: dict[str, dict[str, str]] = {
         "wall_ms_mean": "mean step wall (ms)",
         "wall_ms_max": "max step wall (ms)",
     },
+    "nsa": {
+        "steps": "nsa_step records",
+        "slc_backend": "slc-branch backend of the last step",
+        "backends": "step counts per slc backend",
+        "top_k": "selected blocks per (kv-head, q-block), last step",
+        "hk": "kv heads, last step",
+        "n_qb": "query blocks, last step",
+        "l_slc": "selection block length, last step",
+        "d_stride": "block stride, last step",
+        "executed_bytes_total": "modeled HBM KV bytes streamed, all steps",
+        "gathered_bytes_total": "modeled bytes a gathered slc would move",
+        "gather_savings_ratio": "gathered / executed (>1 = gather-free wins)",
+    },
     "plan_solve": {
         "events": "plan_solve records",
         "solves": "actual solver runs",
@@ -440,6 +453,31 @@ def aggregate(records: list[dict]) -> dict:
             "pages_in_use_max": max(pages) if pages else None,
             "wall_ms_mean": sum(walls) / len(walls) if walls else None,
             "wall_ms_max": max(walls) if walls else None,
+        }
+
+    nsa = kinds.get("nsa_step", [])
+    if nsa:
+        last = nsa[-1]
+        backends: dict[str, int] = {}
+        for r in nsa:
+            b = r.get("slc_backend", "?")
+            backends[b] = backends.get(b, 0) + 1
+        executed = sum(r.get("executed_bytes", 0) for r in nsa)
+        gathered = sum(r.get("gathered_bytes", 0) for r in nsa)
+        agg["nsa"] = {
+            "steps": len(nsa),
+            "slc_backend": last.get("slc_backend"),
+            "backends": dict(sorted(backends.items())),
+            "top_k": last.get("top_k"),
+            "hk": last.get("hk"),
+            "n_qb": last.get("n_qb"),
+            "l_slc": last.get("l_slc"),
+            "d_stride": last.get("d_stride"),
+            "executed_bytes_total": executed,
+            "gathered_bytes_total": gathered,
+            "gather_savings_ratio": (
+                gathered / executed if executed else None
+            ),
         }
 
     solves = kinds.get("plan_solve", [])
@@ -786,6 +824,22 @@ def format_summary(agg: dict) -> str:
                 f"  wall per step: mean={sv['wall_ms_mean']:.1f} ms "
                 f"max={sv['wall_ms_max']:.1f} ms"
             )
+
+    ns = agg.get("nsa")
+    if ns:
+        lines.append("")
+        backends = " ".join(f"{k}={v}" for k, v in ns["backends"].items())
+        lines.append(
+            f"nsa steps={ns['steps']} slc_backend={ns['slc_backend']} "
+            f"({backends}) top_k={ns['top_k']} hk={ns['hk']} "
+            f"n_qb={ns['n_qb']} l_slc={ns['l_slc']} d_stride={ns['d_stride']}"
+        )
+        ratio = ns.get("gather_savings_ratio")
+        lines.append(
+            f"  slc KV bytes: streamed={_fmt_bytes(ns['executed_bytes_total'])}"
+            f" vs gathered={_fmt_bytes(ns['gathered_bytes_total'])}"
+            + (f" (gather-free saves x{ratio:.2f})" if ratio else "")
+        )
 
     ps = agg.get("plan_solve")
     if ps:

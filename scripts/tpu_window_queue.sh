@@ -87,6 +87,13 @@ run_queue() {
   # vs fused at 4096/8192/16384 per family -> bench_bwd.csv, each arm
   # floored at its OWN executed-matmul physics.
   run_step 1800 ".tpu_logs/${TS}_bwd_fused_ab.log" python -u bench.py --bwd-suite || return
+  # gather-free NSA slc A/B — never measured on silicon. Pre-registered
+  # expectation: the block-sparse kernel beats gathered_dense on both
+  # wall time and HBM traffic (modeled: streamed vs gathered bytes differ
+  # by the materialized top_k*l_slc copy, ~2.6x at the suite geometry);
+  # gather_free_speedup > 1 on every family at 8192/32768 -> bench_nsa.csv,
+  # floored at the slc branch's own executed-matmul physics.
+  run_step 1800 ".tpu_logs/${TS}_nsa_ab.log" python -u bench.py --nsa-suite || return
   # two-level (DCN x ICI) comm-plan A/B — never measured on silicon.
   # Pre-registered expectation: post-dedup DCN rows stay <= the flat
   # cross-node volume on every mask x mesh (dcn_ok=True in every row) and

@@ -36,11 +36,12 @@ from magiattention_tpu.analysis.violation import VerifyReport
 
 def test_discovery_finds_every_pallas_site():
     sites = discover_pallas_sites()
-    assert len(sites) == 10
+    assert len(sites) == 12
     names = {s.kernel_name for s in sites}
     assert names == set(_pallas_contracts())
     assert {s.relpath for s in sites} == {
-        "kernels/ffa.py", "kernels/paged_decode.py"
+        "kernels/ffa.py", "kernels/paged_decode.py",
+        "kernels/block_sparse.py",
     }
 
 
@@ -106,12 +107,13 @@ def test_k5_allowlist_entries_carry_a_proof():
 
 def test_seeded_mutations_fire_exactly_their_rule():
     results = run_seeded_mutations()
-    assert len(results) == 8
+    assert len(results) == 9
     assert {r["expected_rule"] for r in results} == {
         "K1", "K2", "K3", "K4", "K5"
     }
     assert {r["mutation"] for r in results} >= {
-        "corrupted_extent_row", "deleted_revisit_init", "oob_page_table"
+        "corrupted_extent_row", "deleted_revisit_init", "oob_page_table",
+        "oob_block_table",
     }
     for r in results:
         assert r["ok"], (

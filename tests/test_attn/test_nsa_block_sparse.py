@@ -1,0 +1,301 @@
+"""NSA baseline parity: ``nsa_attn`` vs a per-segment numpy reference
+across cu_seqlens layouts x GQA groups x dtypes, the gather-free
+block-sparse slc branch vs the gathered-dense reference (fwd allclose +
+vjp parity), and the vectorized ``_p_slc_matrix`` vs its loop original
+(bitwise)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from magiattention_tpu.kernels.block_sparse import (
+    block_sparse_attn,
+    first_visit_flags,
+    validate_block_table,
+)
+from magiattention_tpu.parallel.nsa import (
+    _block_layout,
+    _p_slc_matrix,
+    init_nsa_params,
+    nsa_attn,
+)
+
+S = 288
+HK, DH = 2, 32
+L_CMP, L_SLC, D_STRIDE, BQ, TOP_K = 32, 64, 32, 16, 2
+WINDOW = (64, 0)
+
+CU_LAYOUTS = [
+    [0, 288],
+    [0, 96, 288],
+    [0, 96, 192, 288],
+    [0, 112, 288],
+]
+
+
+def _p_slc_matrix_loop(counts_cmp, counts_slc, l_slc, l_cmp, d):
+    """The pre-vectorization quadruple loop, kept verbatim as the oracle."""
+    alpha, beta = l_slc // d, l_cmp // d
+    n_cmp, n_slc = sum(counts_cmp), sum(counts_slc)
+    M = np.zeros((n_cmp, n_slc), dtype=np.float32)
+    co = so = 0
+    for nc, ns in zip(counts_cmp, counts_slc):
+        for j in range(ns):
+            for m in range(alpha):
+                for n in range(beta):
+                    idx = alpha * j - m - n
+                    if 0 <= idx < nc:
+                        M[co + idx, so + j] += 1.0
+        co += nc
+        so += ns
+    return M
+
+
+def _nsa_numpy_ref(q, k, v, params, cu, g):
+    """Per-segment numpy reference of the full NSA forward (f32 math)."""
+    qn = np.asarray(q, np.float32)
+    kn = np.asarray(k, np.float32)
+    vn = np.asarray(v, np.float32)
+    S_, hq, dh = qn.shape
+    hk = kn.shape[1]
+    scale = dh ** -0.5
+
+    cmp_starts, cmp_seg, cmp_counts = _block_layout(cu, L_CMP, D_STRIDE)
+    slc_starts, slc_seg, slc_counts = _block_layout(cu, L_SLC, D_STRIDE)
+    w_k = np.asarray(params["w_cmp_k"], np.float32)
+    w_v = np.asarray(params["w_cmp_v"], np.float32)
+    k_cmp = np.stack(
+        [kn[s: s + L_CMP].T @ w_k for s in cmp_starts]
+    ).transpose(0, 2, 1) + float(params["b_cmp_k"])  # (n_cmp, hk, dh)
+    v_cmp = np.stack(
+        [vn[s: s + L_CMP].T @ w_v for s in cmp_starts]
+    ).transpose(0, 2, 1) + float(params["b_cmp_v"])
+
+    row_seg = np.zeros(S_, np.int32)
+    for s in range(len(cu) - 1):
+        row_seg[cu[s]: cu[s + 1]] = s
+
+    # cmp branch + p_cmp
+    out_cmp = np.zeros((S_, hq, dh), np.float32)
+    p_cmp = np.zeros((S_, hk, g, len(cmp_starts)), np.float32)
+    for i in range(S_):
+        mask = cmp_seg == row_seg[i]
+        for h in range(hk):
+            for gi in range(g):
+                hqi = h * g + gi
+                logits = np.full(len(cmp_starts), -np.inf, np.float32)
+                logits[mask] = (k_cmp[mask, h] @ qn[i, hqi]) * scale
+                e = np.exp(logits - logits[mask].max())
+                p = e / e.sum()
+                p_cmp[i, h, gi] = p
+                out_cmp[i, hqi] = p[mask] @ v_cmp[mask, h]
+
+    # selection scores -> top-k per (kv head, q block)
+    M = _p_slc_matrix_loop(cmp_counts, slc_counts, L_SLC, L_CMP, D_STRIDE)
+    p_slc = p_cmp.sum(axis=2) @ M  # (S, hk, n_slc)
+    n_qb = S_ // BQ
+    score = p_slc.reshape(n_qb, BQ, hk, len(slc_starts)).sum(1)
+    score = score.transpose(1, 0, 2)  # (hk, n_qb, n_slc)
+    qb_seg = row_seg.reshape(n_qb, BQ)[:, 0]
+    score = np.where(
+        qb_seg[None, :, None] == slc_seg[None, None, :], score, -np.inf
+    )
+    # stable descending sort == jax.lax.top_k tie-breaking (lowest index)
+    idx = np.argsort(-score, axis=-1, kind="stable")[..., :TOP_K]
+
+    # slc branch: gathered attention over the selected blocks
+    out_slc = np.zeros((S_, hq, dh), np.float32)
+    for h in range(hk):
+        for b in range(n_qb):
+            sel = np.concatenate(
+                [np.arange(slc_starts[j], slc_starts[j] + L_SLC)
+                 for j in idx[h, b]]
+            )
+            rows = np.arange(b * BQ, (b + 1) * BQ)
+            for gi in range(g):
+                hqi = h * g + gi
+                s_ = (qn[rows, hqi] @ kn[sel, h].T) * scale
+                p = np.exp(s_ - s_.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                out_slc[rows, hqi] = p @ vn[sel, h]
+
+    # win branch: banded per-segment attention
+    wl = WINDOW[0]
+    out_win = np.zeros((S_, hq, dh), np.float32)
+    for i in range(S_):
+        a, b = cu[row_seg[i]], cu[row_seg[i] + 1]
+        j = np.arange(a, b)
+        live = (j - i >= -wl) & (j - i <= 0)
+        j = j[live]
+        for h in range(hk):
+            for gi in range(g):
+                hqi = h * g + gi
+                s_ = (kn[j, h] @ qn[i, hqi]) * scale
+                p = np.exp(s_ - s_.max())
+                p /= p.sum()
+                out_win[i, hqi] = p @ vn[j, h]
+
+    gate = 1.0 / (1.0 + np.exp(-(
+        qn @ np.asarray(params["w_gate"], np.float32)
+        + np.asarray(params["b_gate"], np.float32)
+    )))
+    return (
+        gate[..., 0:1] * out_cmp
+        + gate[..., 1:2] * out_slc
+        + gate[..., 2:3] * out_win
+    ), idx, slc_starts
+
+
+def _make_inputs(g, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    hq = HK * g
+    q = rng.standard_normal((S, hq, DH)).astype(np.float32)
+    k = rng.standard_normal((S, HK, DH)).astype(np.float32)
+    v = rng.standard_normal((S, HK, DH)).astype(np.float32)
+    params = init_nsa_params(jax.random.PRNGKey(seed), DH, L_CMP)
+    return (
+        jnp.asarray(q, dtype), jnp.asarray(k, dtype), jnp.asarray(v, dtype),
+        params,
+    )
+
+
+def _nsa_kwargs():
+    return dict(
+        l_cmp=L_CMP, l_slc=L_SLC, d_stride=D_STRIDE, block_size_q=BQ,
+        slc_top_k=TOP_K, window=WINDOW, causal=True,
+    )
+
+
+@pytest.mark.parametrize("cu", CU_LAYOUTS, ids=lambda c: f"segs{len(c) - 1}")
+@pytest.mark.parametrize("g", [1, 2, 4])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_nsa_attn_matches_numpy_reference(cu, g, dtype):
+    q, k, v, params = _make_inputs(g, dtype)
+    out = np.asarray(
+        nsa_attn(q, k, v, params, cu, **_nsa_kwargs()), np.float32
+    )
+    ref, _, _ = _nsa_numpy_ref(
+        np.asarray(q, np.float32), np.asarray(k, np.float32),
+        np.asarray(v, np.float32), params, cu, g,
+    )
+    tol = 5e-5 if dtype == "float32" else 4e-2
+    np.testing.assert_allclose(out, ref, atol=tol, rtol=tol)
+
+
+@pytest.mark.parametrize("cu", CU_LAYOUTS, ids=lambda c: f"segs{len(c) - 1}")
+@pytest.mark.parametrize("g", [1, 2, 4])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_gather_free_matches_gathered_branch(cu, g, dtype, monkeypatch):
+    """The full nsa_attn forward under both slc backends (env pin flips
+    bypass the registry memo, so two calls A/B the branch in-process)."""
+    q, k, v, params = _make_inputs(g, dtype, seed=1)
+    monkeypatch.setenv("MAGI_ATTENTION_BACKEND_NSA_SLC", "gathered_dense")
+    out_g = nsa_attn(q, k, v, params, cu, **_nsa_kwargs())
+    monkeypatch.setenv(
+        "MAGI_ATTENTION_BACKEND_NSA_SLC", "block_sparse_pallas"
+    )
+    out_k = nsa_attn(q, k, v, params, cu, **_nsa_kwargs())
+    tol = 2e-5 if dtype == "float32" else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(out_g, np.float32), np.asarray(out_k, np.float32),
+        atol=tol, rtol=tol,
+    )
+
+
+@pytest.mark.parametrize("g", [1, 2, 4])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_block_sparse_kernel_vjp_parity(g, dtype):
+    """Kernel-level fwd + vjp parity against a gathered jnp slc branch on
+    the same index table (overlapping stride-32 blocks)."""
+    rng = np.random.default_rng(2)
+    S_, hk, dh = 256, 2, 32
+    hq = hk * g
+    starts = np.arange(0, S_ - L_SLC + 1, D_STRIDE, dtype=np.int32)
+    n_blocks, n_qb = len(starts), S_ // BQ
+    idx = np.stack([
+        rng.choice(n_blocks, size=TOP_K, replace=False)
+        for _ in range(hk * n_qb)
+    ]).reshape(hk, n_qb, TOP_K).astype(np.int32)
+    q = jnp.asarray(rng.standard_normal((S_, hq, dh)), dtype)
+    k = jnp.asarray(rng.standard_normal((S_, hk, dh)), dtype)
+    v = jnp.asarray(rng.standard_normal((S_, hk, dh)), dtype)
+    do = jnp.asarray(rng.standard_normal((S_, hq, dh)), dtype)
+    scale = dh ** -0.5
+
+    def gathered(q_, k_, v_):
+        kb = jnp.stack([k_[s: s + L_SLC] for s in starts])  # (nb, l, hk, d)
+        vb = jnp.stack([v_[s: s + L_SLC] for s in starts])
+        k_sel = jnp.take_along_axis(
+            kb.transpose(2, 0, 1, 3)[:, None], idx[..., None, None], axis=2
+        ).reshape(hk, n_qb, TOP_K * L_SLC, dh)
+        v_sel = jnp.take_along_axis(
+            vb.transpose(2, 0, 1, 3)[:, None], idx[..., None, None], axis=2
+        ).reshape(hk, n_qb, TOP_K * L_SLC, dh)
+        qb = q_.reshape(n_qb, BQ, hk, g, dh)
+        s_ = jnp.einsum("bqhgd,hbld->hbgql", qb, k_sel).astype(
+            jnp.float32
+        ) * scale
+        p = jax.nn.softmax(s_, axis=-1)
+        return jnp.einsum(
+            "hbgql,hbld->bqhgd", p.astype(q_.dtype), v_sel
+        ).reshape(S_, hq, dh)
+
+    def kernel(q_, k_, v_):
+        out, _ = block_sparse_attn(
+            q_, k_, v_, jnp.asarray(idx), starts, block_len=L_SLC,
+            d_stride=D_STRIDE, block_size_q=BQ, softmax_scale=scale,
+        )
+        return out
+
+    tol = 2e-5 if dtype == "float32" else 2e-2
+    out_g = np.asarray(gathered(q, k, v), np.float32)
+    out_k = np.asarray(kernel(q, k, v), np.float32)
+    np.testing.assert_allclose(out_g, out_k, atol=tol, rtol=tol)
+
+    loss_g = lambda *a: jnp.sum(gathered(*a).astype(jnp.float32) * do)
+    loss_k = lambda *a: jnp.sum(kernel(*a).astype(jnp.float32) * do)
+    grads_g = jax.grad(loss_g, argnums=(0, 1, 2))(q, k, v)
+    grads_k = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    gtol = 5e-5 if dtype == "float32" else 1e-1
+    for name, a, b in zip("dq dk dv".split(), grads_g, grads_k):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=gtol, rtol=gtol, err_msg=name,
+        )
+
+
+def test_p_slc_matrix_vectorization_bitwise():
+    for counts_cmp, counts_slc, l_slc, l_cmp, d in [
+        ([9, 5], [7, 3], 64, 32, 32),
+        ([12], [10], 96, 32, 32),
+        ([4, 4, 4], [2, 2, 2], 64, 64, 32),
+        ([17, 3], [15, 1], 128, 32, 16),
+    ]:
+        vec = _p_slc_matrix(counts_cmp, counts_slc, l_slc, l_cmp, d)
+        loop = _p_slc_matrix_loop(counts_cmp, counts_slc, l_slc, l_cmp, d)
+        assert vec.dtype == loop.dtype and (vec == loop).all()
+
+
+def test_first_visit_flags_and_table_audit():
+    tbl = jnp.asarray(
+        np.array([[[0, 1, 1, 2], [1, 2, 3, 3]]], np.int32)
+    )  # (hk=1, n_qb=2, C=4)
+    fv = np.asarray(first_visit_flags(tbl, 5))
+    assert fv.tolist() == [[[1, 1, 0, 1], [0, 0, 1, 0]]]
+
+    validate_block_table(np.array([[[0, 2], [1, 3]]]), 4)
+    with pytest.raises(ValueError, match="out of range"):
+        validate_block_table(np.array([[[0, 4]]]), 4)
+    with pytest.raises(ValueError, match="duplicate"):
+        validate_block_table(np.array([[[2, 2]]]), 4)
+    with pytest.raises(ValueError, match="out of range"):
+        block_sparse_attn(
+            jnp.zeros((64, 2, 32)), jnp.zeros((64, 1, 32)),
+            jnp.zeros((64, 1, 32)),
+            jnp.asarray(np.array([[[99, 0]]] * 1, np.int32)
+                        .repeat(4, axis=1)),
+            np.arange(0, 33, 32, dtype=np.int32),
+            block_len=32, block_size_q=16,
+        )

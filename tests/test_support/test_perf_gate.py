@@ -1,0 +1,81 @@
+"""Perf-gate behavior on young and regressing bench histories: a 0- or
+1-row CSV must pass-with-note (freshly opened trajectories like the first
+``--nsa-suite`` run cannot regress), and a >10% regression row must block
+unless it carries a BENCH waiver."""
+
+import csv
+import os
+
+from tests.test_support.script_loading import load_script
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+GATE = os.path.join(REPO, "scripts", "perf_gate.py")
+
+HEADER = ["utc", "commit", "family", "seq", "wall_ms", "timing_mode"]
+
+
+def _write_csv(path, rows):
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=HEADER)
+        w.writeheader()
+        for row in rows:
+            w.writerow(row)
+
+
+def _row(wall_ms, commit="c1", note=""):
+    return {
+        "utc": "2026-08-05T00:00:00Z",
+        "commit": commit,
+        "family": "nsa_block_sparse",
+        "seq": "1024",
+        "wall_ms": str(wall_ms),
+        "timing_mode": note or "chained_cpu",
+    }
+
+
+def test_zero_row_history_passes_with_note(tmp_path):
+    gate = load_script(GATE, "perf_gate_t0")
+    path = tmp_path / "bench_nsa.csv"
+    _write_csv(path, [])
+    findings, notes = gate.gate_file(str(path), 0.10)
+    assert findings == []
+    assert len(notes) == 1 and "0 row(s)" in notes[0]
+    assert gate.main(["--history", str(tmp_path)]) == 0
+
+
+def test_one_row_history_passes_with_note(tmp_path):
+    gate = load_script(GATE, "perf_gate_t1")
+    path = tmp_path / "bench_nsa.csv"
+    _write_csv(path, [_row(12.5)])
+    findings, notes = gate.gate_file(str(path), 0.10)
+    assert findings == []
+    assert len(notes) == 1 and "1 row(s)" in notes[0]
+    assert gate.main(["--history", str(tmp_path)]) == 0
+
+
+def test_regression_row_blocks(tmp_path):
+    gate = load_script(GATE, "perf_gate_t2")
+    path = tmp_path / "bench_nsa.csv"
+    _write_csv(path, [_row(10.0, "c1"), _row(13.0, "c2")])
+    findings, notes = gate.gate_file(str(path), 0.10)
+    assert notes == []
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["metric"] == "wall_ms" and not f["waived"]
+    assert gate.main(["--history", str(tmp_path)]) == 1
+
+
+def test_waived_regression_passes(tmp_path):
+    gate = load_script(GATE, "perf_gate_t3")
+    path = tmp_path / "bench_nsa.csv"
+    # the waiver note rides a stamp column (commit) — stamps are excluded
+    # from the config key, so the rows still pair up for comparison
+    _write_csv(
+        path,
+        [_row(10.0, "c1"), _row(13.0, "c2 BENCH: intentional regression")],
+    )
+    findings, _ = gate.gate_file(str(path), 0.10)
+    assert len(findings) == 1 and findings[0]["waived"]
+    assert gate.main(["--history", str(tmp_path)]) == 0
