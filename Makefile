@@ -53,7 +53,8 @@ perf-gate:  ## fail on >10% bench regression vs prior run without a BENCH note
 	$(PY) scripts/perf_gate.py
 
 chaos:  ## fault-injection chaos matrix: every site recovers or raises typed
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience -x -q -m chaos
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+		$(PY) -m pytest tests/test_resilience -x -q -m chaos
 
 nsa-needle-smoke:  ## needle-in-haystack retrieval through the gather-free NSA kernel (CPU interpret)
 	JAX_PLATFORMS=cpu $(PY) examples/needle_1m.py --smoke

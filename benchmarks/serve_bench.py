@@ -11,7 +11,16 @@ On a TPU chip this measures the real paged-decode kernel; on CPU the
 kernels run in interpret mode, so the numbers are relative-cost smoke
 only (the scheduler/cache overheads are still real host work).
 
+Scale-axis A/B (docs/serving_scale.md): ``--kv-dtype int8``,
+``--spec-tokens 2`` and ``--shards 2`` select the quantized, speculative
+and mesh-sharded decode backends; each combination is its own config
+group in ``bench_serve.csv``, so the perf gate trends
+``decode_rate_tok_s_chip`` (higher-better), ``accept_rate``
+(higher-better) and ``ttft_load_p50_ms`` (lower-better) per backend.
+
     python benchmarks/serve_bench.py --requests 16 --slots 4 --cpu
+    python benchmarks/serve_bench.py --cpu --spec-tokens 2
+    python benchmarks/serve_bench.py --cpu --kv-dtype int8
 """
 
 from __future__ import annotations
@@ -75,6 +84,13 @@ def main() -> int:
     ap.add_argument("--page-size", type=int, default=16)
     ap.add_argument("--prefill-chunk", type=int, default=32)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kv-dtype", default="float32",
+                    choices=("float32", "int8"),
+                    help="KV cache dtype (int8 = quantized decode backend)")
+    ap.add_argument("--spec-tokens", type=int, default=1,
+                    help="draft tokens per tick (>1 = speculative verify)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="kv-head mesh width for the sharded decode backend")
     ap.add_argument("--cpu", action="store_true",
                     help="force JAX_PLATFORMS=cpu (interpret-mode kernels)")
     ap.add_argument("--no-history", action="store_true",
@@ -83,6 +99,13 @@ def main() -> int:
 
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.shards > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.shards}"
+            ).strip()
 
     from magiattention_tpu.benchmarking.perf_report import append_row
     from magiattention_tpu.serving import ServeConfig, ServeEngine, ToyModel
@@ -96,12 +119,23 @@ def main() -> int:
             1, -(-((64 + 16) * 1) // args.page_size)  # longest prompt + gen
         ),
         prefill_chunk=args.prefill_chunk,
+        kv_dtype=args.kv_dtype,
+        spec_tokens=args.spec_tokens,
+        decode_shards=args.shards,
+        pool_shards=args.shards if args.pages % args.shards == 0 else 1,
     )
     requests = make_workload(model, args.requests, args.seed)
     total_new = sum(r.max_new_tokens for r in requests)
 
     engine = ServeEngine(model, config)
-    finished = engine.run(requests)
+    for req in requests:
+        engine.submit(req)
+    step_stats = []
+    while engine.scheduler.has_work():
+        step_stats.append(engine.step())
+        if engine.step_count > 100_000:
+            raise RuntimeError("serving loop did not drain")
+    finished = engine.finished
 
     ttft = [
         (r.first_token_time - r.submit_time) * 1e3
@@ -118,11 +152,30 @@ def main() -> int:
     ]
     evictions = sum(r.evictions for r in requests)
 
+    # scale-axis metrics: tokens/sec/chip over the decode wall time,
+    # accepted tokens per decode tick (== decode throughput lever the
+    # speculative backend pulls), TTFT under saturated-pool load
+    decode_wall_s = sum(s["wall_ms"] for s in step_stats) * 1e-3
+    decoded = sum(s["decode_tokens"] for s in step_stats)
+    attempted = sum(s["draft_attempted"] for s in step_stats)
+    accepted = sum(s["draft_accepted"] for s in step_stats)
+    decode_ticks = sum(1 for s in step_stats if s["draft_attempted"])
+    chips = max(1, args.shards)
+    decode_rate = decoded / decode_wall_s / chips if decode_wall_s else 0.0
+    accepted_per_tick = accepted / decode_ticks if decode_ticks else 0.0
+    accept_rate = accepted / attempted if attempted else 0.0
+
     print(
         f"serve bench: {len(finished)}/{len(requests)} requests, "
         f"{total_new} new tokens in {engine.step_count} steps "
         f"({evictions} evictions, slots={args.slots}, "
-        f"pages={args.pages}x{args.page_size})"
+        f"pages={args.pages}x{args.page_size}, kv={args.kv_dtype}, "
+        f"spec_k={args.spec_tokens}, shards={args.shards})"
+    )
+    print(
+        f"  decode: {decode_rate:.1f} tok/s/chip, "
+        f"{accepted_per_tick:.2f} accepted/tick "
+        f"(accept rate {accept_rate:.1%})"
     )
     print(histogram(ttft, "time to first token"))
     print(histogram(total, "request latency"))
@@ -137,9 +190,17 @@ def main() -> int:
                 "slots": args.slots,
                 "pages": args.pages,
                 "page_size": args.page_size,
+                "kv_dtype": args.kv_dtype,
+                "spec_tokens": args.spec_tokens,
+                "shards": args.shards,
                 "steps": engine.step_count,
                 "evictions": evictions,
                 "new_tokens": total_new,
+                "decode_rate_tok_s_chip": round(decode_rate, 2),
+                # 'rate' suffix keeps perf_gate treating it higher-better
+                "accepted_per_tick_rate": round(accepted_per_tick, 3),
+                "accept_rate": round(accept_rate, 4),
+                "ttft_load_p50_ms": round(float(np.percentile(ttft, 50)), 3),
                 "ttft_p50_ms": round(float(np.percentile(ttft, 50)), 3),
                 "ttft_p99_ms": round(float(np.percentile(ttft, 99)), 3),
                 "latency_p50_ms": round(float(np.percentile(total, 50)), 3),

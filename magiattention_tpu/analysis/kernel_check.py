@@ -483,7 +483,10 @@ def capture_ffa_contracts(spec: AuditSpec) -> list[KernelContract]:
 
 @dataclass(frozen=True, eq=False)
 class DecodeAuditSpec:
-    """One paged-decode corpus configuration (kernels/paged_decode.py)."""
+    """One paged-decode corpus configuration (kernels/paged_decode.py).
+    ``variant`` picks the wrapper driven: "base" (one row per slot),
+    "spec" (``spec_k`` draft rows per slot, the speculative-verify
+    kernel) or "int8" (quantized pages + per-page scale prefetch)."""
 
     name: str
     max_seqs: int = 4
@@ -496,12 +499,16 @@ class DecodeAuditSpec:
     dv: int = 128
     dtype: str = "bfloat16"
     lengths: tuple[int, ...] | None = None
+    variant: str = "base"
+    spec_k: int = 2
 
 
 def decode_corpus() -> list[DecodeAuditSpec]:
-    """Configs the decode kernel is captured at: the serving default, a
-    wide-page fp32 variant, and a ragged batch with dead slots + partially
-    allocated page-table rows (-1 entries exercise the clamp index map)."""
+    """Configs the decode kernels are captured at: the serving default, a
+    wide-page fp32 variant, a ragged batch with dead slots + partially
+    allocated page-table rows (-1 entries exercise the clamp index map),
+    plus spec-verify (multi-row q tiles, both group widths) and int8
+    (scale-prefetch index maps, fp32 compute dtype — the engine's) riders."""
     return [
         DecodeAuditSpec(name="decode/bfloat16/g2/ps128"),
         DecodeAuditSpec(
@@ -511,6 +518,22 @@ def decode_corpus() -> list[DecodeAuditSpec]:
         DecodeAuditSpec(
             name="decode/bfloat16/g4/ragged", hq=8,
             lengths=(5, 0, 259, 128),
+        ),
+        DecodeAuditSpec(
+            name="decode_spec/bfloat16/g2/k2/ps128", variant="spec",
+        ),
+        DecodeAuditSpec(
+            name="decode_spec/float32/g4/k4/ragged", variant="spec",
+            dtype="float32", hq=8, spec_k=4, lengths=(5, 0, 259, 128),
+        ),
+        DecodeAuditSpec(
+            name="decode_int8/float32/g2/ps128", variant="int8",
+            dtype="float32",
+        ),
+        DecodeAuditSpec(
+            name="decode_int8/float32/g1/ps256", variant="int8",
+            dtype="float32", hq=2, page_size=256, num_pages=16,
+            pages_per_seq=4,
         ),
     ]
 
@@ -539,22 +562,38 @@ def capture_decode_contracts(spec: DecodeAuditSpec) -> list[KernelContract]:
             table[s, j] = nxt % spec.num_pages
             nxt += 1
     dtype = jnp.dtype(spec.dtype)
+    kv_dtype = jnp.int8 if spec.variant == "int8" else dtype
+    scales = (
+        jnp.zeros((spec.num_pages, spec.hk), jnp.float32)
+        if spec.variant == "int8"
+        else None
+    )
     cache = PagedKVCache(
         k_pages=jnp.zeros(
-            (spec.num_pages, ps, spec.hk, spec.d), dtype
+            (spec.num_pages, ps, spec.hk, spec.d), kv_dtype
         ),
         v_pages=jnp.zeros(
-            (spec.num_pages, ps, spec.hk, spec.dv), dtype
+            (spec.num_pages, ps, spec.hk, spec.dv), kv_dtype
         ),
         page_table=jnp.asarray(table),
         lengths=jnp.asarray(np.asarray(lengths, np.int32)),
+        k_scales=scales,
+        v_scales=scales,
     )
-    q = jnp.zeros((spec.max_seqs, spec.hq, spec.d), dtype)
+    if spec.variant == "spec":
+        q = jnp.zeros((spec.max_seqs, spec.spec_k, spec.hq, spec.d), dtype)
+        drive = lambda: paged_decode.paged_decode_attn_spec(q, cache)  # noqa: E731
+    elif spec.variant == "int8":
+        q = jnp.zeros((spec.max_seqs, spec.hq, spec.d), dtype)
+        drive = lambda: paged_decode.paged_decode_attn_int8(q, cache)  # noqa: E731
+    else:
+        q = jnp.zeros((spec.max_seqs, spec.hq, spec.d), dtype)
+        drive = lambda: paged_decode.paged_decode_attn(q, cache)  # noqa: E731
     cap = _capture_pallas()
     with jax.default_device(jax.devices("cpu")[0]):
         with cap:
             try:
-                paged_decode.paged_decode_attn(q, cache)
+                drive()
             except _Captured:
                 pass
     return cap.contracts
@@ -677,13 +716,23 @@ def _contract_shape_info(contract: KernelContract) -> dict:
             emit_ml=False,
         )
     if "decode" in name:
-        # paged-decode kernel: q block (1, 1, g, d), k/v blocks
-        # (1, page_size, 1, d|dv); bq = group rows, bk = page size
+        # paged-decode kernels: q block (1, 1, rows, d), k/v blocks
+        # (1, page_size, 1, d|dv); bq = q-tile rows (GQA group rows, or
+        # spec_k * group rows for the verify variant), bk = page size.
+        # int8/spec substrings dispatch to their own residency kinds and
+        # MUST be tested before the generic branch — their names also
+        # contain "decode". itemsize is always q's dtype; the int8 kind
+        # bakes the 1-byte k/v payload + f32 scale blocks into its formula.
         q_block = contract.in_specs[0].block_shape
         k_block = contract.in_specs[1].block_shape
         v_block = contract.in_specs[2].block_shape
+        kind = (
+            "decode_int8" if "int8" in name
+            else "decode_spec" if "spec" in name
+            else "decode"
+        )
         return dict(
-            kind="decode", packed=False, g=1,
+            kind=kind, packed=False, g=1,
             bq=int(q_block[2]), bk=int(k_block[1]),
             d=int(q_block[3]), dv=int(v_block[3]),
             itemsize=np.dtype(contract.operands[0][1]).itemsize,
@@ -2038,6 +2087,33 @@ def run_seeded_mutations() -> list[dict]:
         )
         check_contract(report, mut, "mutation:oob_page_table")
 
+    def misrouted_scale_prefetch(report: VerifyReport) -> None:
+        # swap the (page, head) outputs of the int8 per-page scale index
+        # map: the head coordinate (< hk) silently fits the page axis, but
+        # real page ids land on the hk-wide head axis of the (num_pages,
+        # hk) scale array — the decode output would mix WRONG pages'
+        # scales without faulting, and only the K3 bounds eval over the
+        # real page-table prefetch catches the escape
+        ibase = next(
+            c for c in capture_decode_contracts(
+                next(s for s in decode_corpus() if s.variant == "int8")
+            )
+            if c.kernel_name == "_paged_decode_int8_kernel"
+        )
+        ks_spec = ibase.in_specs[3]
+        orig = ks_spec.index_map
+        shim = SimpleNamespace(
+            block_shape=ks_spec.block_shape,
+            index_map=lambda *a: (lambda o: (o[1], o[0]))(orig(*a)),
+        )
+        mut = replace(
+            ibase,
+            in_specs=tuple(ibase.in_specs[:3])
+            + (shim,)
+            + tuple(ibase.in_specs[4:]),
+        )
+        check_contract(report, mut, "mutation:misrouted_scale_prefetch")
+
     def oob_block_table(report: VerifyReport) -> None:
         # point one chunk-table entry one past the last chunk: the block-
         # sparse index maps consume the table UNclamped (the public wrapper
@@ -2062,5 +2138,6 @@ def run_seeded_mutations() -> list[dict]:
     run("unlisted_env_key", "K5", unlisted_key)
     run("corrupted_extent_row", "K3", bad_extent)
     run("oob_page_table", "K3", oob_page_table)
+    run("misrouted_scale_prefetch", "K3", misrouted_scale_prefetch)
     run("oob_block_table", "K3", oob_block_table)
     return results

@@ -102,15 +102,26 @@ def mixed_blocks_pin() -> str | None:
 
 
 def serve_decode_pin() -> str | None:
-    """Pin for the serve decode rung: 'paged_decode' | 'gather_ffa' |
-    'dense' | None.
+    """Pin for the serve decode rung: 'paged_decode_sharded' |
+    'paged_decode_spec' | 'paged_decode_int8' | 'paged_decode' |
+    'gather_ffa' | 'dense' | None.
 
     MAGI_ATTENTION_BACKEND_SERVE_DECODE wins; legacy
     MAGI_ATTENTION_SERVE_DECODE_KERNEL maps 1->paged_decode, 0->gather_ffa,
     auto->None. The resilience ladder still descends from the pinned rung
-    on kernel failure."""
+    on kernel failure, and a pin remains subject to the call site's
+    feasibility guards (shard divisibility, cache dtype, 1-row vs
+    multi-row step) — an infeasible pin starts at the first feasible rung
+    below it."""
     val = _get_str("MAGI_ATTENTION_BACKEND_SERVE_DECODE", "").lower()
-    if val in ("paged_decode", "gather_ffa", "dense"):
+    if val in (
+        "paged_decode_sharded",
+        "paged_decode_spec",
+        "paged_decode_int8",
+        "paged_decode",
+        "gather_ffa",
+        "dense",
+    ):
         return val
     legacy = os.environ.get("MAGI_ATTENTION_SERVE_DECODE_KERNEL")
     if legacy == "1":

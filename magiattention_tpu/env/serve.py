@@ -9,7 +9,7 @@ kernel layer.
 
 from __future__ import annotations
 
-from .general import _get_int
+from .general import _get_int, _get_str
 
 
 def serve_max_slots() -> int:
@@ -35,3 +35,34 @@ def serve_prefill_chunk() -> int:
     ServeConfig.from_env; prompts are prefilled in chunks of this size
     interleaved with decode steps."""
     return _get_int("MAGI_ATTENTION_SERVE_PREFILL_CHUNK", 64)
+
+
+def serve_kv_dtype() -> str:
+    """KV-cache storage dtype for ServeConfig.from_env: 'float32' (exact,
+    the bitwise-oracle dtype) or 'int8' (per-page symmetric quantization —
+    ~4x the slot residency per HBM budget, decoded by the
+    paged_decode_int8 rung within tolerance)."""
+    return _get_str("MAGI_ATTENTION_SERVE_KV_DTYPE", "float32").lower()
+
+
+def serve_spec_tokens() -> int:
+    """Draft tokens verified per engine tick for ServeConfig.from_env.
+    1 = the classic one-token-per-tick loop; k>1 drafts k-1 extra inputs
+    per tick, verifies all k rows in one kernel launch, and commits the
+    longest accepted prefix (rejects roll back page-exactly)."""
+    return _get_int("MAGI_ATTENTION_SERVE_SPEC_TOKENS", 1)
+
+
+def serve_shards() -> int:
+    """kv-head mesh width for the sharded decode rung (ServeConfig
+    .from_env). >1 requires that many local devices and
+    hk % shards == 0; 1 keeps decode single-device."""
+    return _get_int("MAGI_ATTENTION_SERVE_SHARDS", 1)
+
+
+def serve_pool_shards() -> int:
+    """Page-pool partition count for ServeConfig.from_env: the pool's page
+    ids split into this many independent free-lists and the scheduler
+    routes each admitted slot to the emptiest partition (1 = the single
+    FIFO pool)."""
+    return _get_int("MAGI_ATTENTION_SERVE_POOL_SHARDS", 1)

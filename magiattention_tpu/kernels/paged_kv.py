@@ -43,10 +43,18 @@ class PagedKVCache:
     v_pages: jax.Array
     page_table: jax.Array
     lengths: jax.Array
+    # quantized-cache extension: per-(page, kv head) symmetric scales for
+    # int8 pages (None on float caches; value = code * scale)
+    k_scales: jax.Array | None = None
+    v_scales: jax.Array | None = None
 
     @property
     def page_size(self) -> int:
         return self.k_pages.shape[1]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scales is not None
 
     @classmethod
     def create(
@@ -59,6 +67,12 @@ class PagedKVCache:
         max_pages_per_seq: int,
         dtype=jnp.bfloat16,
     ) -> "PagedKVCache":
+        quantized = jnp.dtype(dtype) == jnp.int8
+        scales = (
+            jnp.zeros((num_pages, n_kv_heads), jnp.float32)
+            if quantized
+            else None
+        )
         return cls(
             k_pages=jnp.zeros(
                 (num_pages, page_size, n_kv_heads, head_dim), dtype
@@ -70,6 +84,8 @@ class PagedKVCache:
                 (max_seqs, max_pages_per_seq), -1, jnp.int32
             ),
             lengths=jnp.zeros((max_seqs,), jnp.int32),
+            k_scales=scales,
+            v_scales=None if scales is None else jnp.zeros_like(scales),
         )
 
 
@@ -80,7 +96,54 @@ def assign_pages(
     table = cache.page_table.at[seq_id, : len(page_ids)].set(
         jnp.asarray(page_ids, jnp.int32)
     )
-    return PagedKVCache(cache.k_pages, cache.v_pages, table, cache.lengths)
+    return PagedKVCache(
+        cache.k_pages, cache.v_pages, table, cache.lengths,
+        cache.k_scales, cache.v_scales,
+    )
+
+
+def rollback_kv(cache: PagedKVCache, seq_id, new_length) -> PagedKVCache:
+    """Discard a sequence's rows past ``new_length`` (speculative-verify
+    rollback). Pure length bookkeeping: rejected rows stay as garbage in
+    their pages and are dead under the length mask; the next append
+    overwrites them in place (quantized pages keep their scale — the
+    rescale-on-append algebra already handles overwritten rows)."""
+    lengths = cache.lengths.at[seq_id].set(
+        jnp.asarray(new_length, jnp.int32)
+    )
+    return PagedKVCache(
+        cache.k_pages, cache.v_pages, cache.page_table, lengths,
+        cache.k_scales, cache.v_scales,
+    )
+
+
+def _quantize_append(pages, scales, page_idx, row, x_new):
+    """Append f32 rows into int8 pages with monotone per-(page, head)
+    symmetric scales.
+
+    Row i may raise its page's scale (new_scale = max(old, |x|_max / 127));
+    existing codes of that page are rescaled by old/new (codes only ever
+    shrink, so no clipping error) before the new row is quantized. Scale
+    growth is monotone within a page's lifetime, which makes the stored
+    values a pure function of the append history — the property the
+    bitwise engine-vs-oracle comparisons rely on (reset on release).
+    """
+    t = x_new.shape[0]
+    for i in range(t):
+        p = page_idx[i]
+        xi = x_new[i].astype(jnp.float32)  # (hk, d)
+        cand = jnp.max(jnp.abs(xi), axis=-1) / 127.0  # (hk,)
+        old = scales[p]
+        new = jnp.maximum(old, cand)
+        safe = jnp.where(new > 0.0, new, 1.0)
+        ratio = old / safe  # 0 where the page was fresh
+        page = jnp.round(pages[p].astype(jnp.float32) * ratio[None, :, None])
+        page = jnp.clip(page, -127, 127)
+        row_q = jnp.clip(jnp.round(xi / safe[:, None]), -127, 127)
+        page = page.at[row[i]].set(row_q)
+        pages = pages.at[p].set(page.astype(jnp.int8))
+        scales = scales.at[p].set(new)
+    return pages, scales
 
 
 def append_kv(
@@ -89,7 +152,8 @@ def append_kv(
     """Append ``(t, hk, d)`` new rows to a sequence (pages pre-assigned).
 
     ``t`` is static (typically 1 for decode, chunk for prefill); positions
-    are ``lengths[seq_id] .. +t``. Functional update — jit-safe.
+    are ``lengths[seq_id] .. +t``. Functional update — jit-safe. Quantized
+    caches quantize rows on the way in (per-page symmetric int8 scales).
     """
     t = k_new.shape[0]
     start = cache.lengths[seq_id]
@@ -98,10 +162,21 @@ def append_kv(
     page_idx = cache.page_table[seq_id, pos // ps]  # (t,)
     row = pos % ps
 
-    k_pages = cache.k_pages.at[page_idx, row].set(k_new)
-    v_pages = cache.v_pages.at[page_idx, row].set(v_new)
+    if cache.quantized:
+        k_pages, k_scales = _quantize_append(
+            cache.k_pages, cache.k_scales, page_idx, row, k_new
+        )
+        v_pages, v_scales = _quantize_append(
+            cache.v_pages, cache.v_scales, page_idx, row, v_new
+        )
+    else:
+        k_pages = cache.k_pages.at[page_idx, row].set(k_new)
+        v_pages = cache.v_pages.at[page_idx, row].set(v_new)
+        k_scales, v_scales = cache.k_scales, cache.v_scales
     lengths = cache.lengths.at[seq_id].set(start + t)
-    return PagedKVCache(k_pages, v_pages, cache.page_table, lengths)
+    return PagedKVCache(
+        k_pages, v_pages, cache.page_table, lengths, k_scales, v_scales
+    )
 
 
 def gather_kv(
@@ -116,6 +191,13 @@ def gather_kv(
     safe = jnp.maximum(table, 0)
     k = jnp.take(cache.k_pages, safe, axis=0)  # (P, ps, hk, d)
     v = jnp.take(cache.v_pages, safe, axis=0)
+    if cache.quantized:
+        # dequant on gather so every downstream consumer (FFA prefill,
+        # gather/dense decode rungs, the replay oracle) sees f32 values
+        ks = jnp.take(cache.k_scales, safe, axis=0)  # (P, hk)
+        vs = jnp.take(cache.v_scales, safe, axis=0)
+        k = k.astype(jnp.float32) * ks[:, None, :, None]
+        v = v.astype(jnp.float32) * vs[:, None, :, None]
     ps = cache.page_size
     p = k.shape[0]
     return (

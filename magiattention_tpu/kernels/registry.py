@@ -347,11 +347,20 @@ register_backend(
 register_backend(
     "ffa_lowering", "single_dot", 1, "legacy full-tile dot bodies")
 register_backend(
-    "serve_decode", "paged_decode", 0, "Pallas ragged paged-decode kernel")
+    "serve_decode", "paged_decode_sharded", 0,
+    "paged-decode kernel shard_mapped over kv heads (one launch per shard)")
 register_backend(
-    "serve_decode", "gather_ffa", 1, "per-slot gather+FFA reference")
+    "serve_decode", "paged_decode_spec", 1,
+    "multi-token speculative-verify kernel (spec_k draft rows per q tile)")
 register_backend(
-    "serve_decode", "dense", 2, "dense jnp softmax — last resort")
+    "serve_decode", "paged_decode_int8", 2,
+    "int8-KV paged-decode kernel (per-page scales, dequant in-kernel)")
+register_backend(
+    "serve_decode", "paged_decode", 3, "Pallas ragged paged-decode kernel")
+register_backend(
+    "serve_decode", "gather_ffa", 4, "per-slot gather+FFA reference")
+register_backend(
+    "serve_decode", "dense", 5, "dense jnp softmax — last resort")
 register_backend(
     "nsa_slc", "block_sparse_pallas", 0,
     "gather-free Pallas block-sparse slc kernel")

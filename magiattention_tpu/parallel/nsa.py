@@ -79,23 +79,34 @@ def _p_slc_matrix(
     counts_cmp: list[int], counts_slc: list[int], l_slc: int, l_cmp: int,
     d: int,
 ) -> np.ndarray:
-    """(n_cmp_total, n_slc_total) 0/1 aggregation: P_slc = P_cmp @ M
-    (ref compute_p_slc: slc block j accumulates cmp blocks alpha*j - m - n
-    for m < alpha, n < beta, per segment)."""
+    """(n_cmp_total, n_slc_total) aggregation weights: P_slc = P_cmp @ M.
+
+    BOTH block families come from :func:`_block_layout`, i.e. both are
+    anchored at stride ``d``: cmp block i covers d-chunks ``[i, i + beta)``
+    and slc block j covers ``[j, j + alpha)`` (alpha = l_slc/d, beta =
+    l_cmp/d). The weight is their chunk-overlap count — the number of
+    stride-d chunks the two windows share:
+
+        M[i, j] = max(0, min(i + beta, j + alpha) - max(i, j))
+
+    a small-integer count, exact in f32. At alpha == beta == 1 this is the
+    identity, matching the ``p_slc = p_cmp`` shortcut in :func:`nsa_attn`.
+
+    (An earlier revision anchored slc blocks at stride ``l_slc`` — the
+    non-overlapping layout of the reference ``compute_p_slc`` — while
+    ``_block_layout`` emits stride-``d`` windows; for l_slc=2d, l_cmp=d
+    that scored slc block j from cmp blocks {2j-1, 2j} instead of the
+    overlapping {j, j+1}, so top-k selected windows that missed the very
+    keys that scored them. The misaligned-stride parity test pins this.)
+    """
     alpha, beta = l_slc // d, l_cmp // d
     n_cmp, n_slc = sum(counts_cmp), sum(counts_slc)
     M = np.zeros((n_cmp, n_slc), dtype=np.float32)
     co = so = 0
     for nc, ns in zip(counts_cmp, counts_slc):
-        # cmp block i feeds slc block j once per (m, n) pair with
-        # m + n == alpha*j - i, m < alpha, n < beta: a small-integer count
-        # (exact in f32, so broadcast == the accumulation loop bitwise)
-        o = alpha * np.arange(ns)[None, :] - np.arange(nc)[:, None]
-        cnt = np.minimum(o, alpha - 1) - np.maximum(0, o - beta + 1) + 1
-        in_range = (o >= 0) & (o <= alpha + beta - 2)
-        M[co:co + nc, so:so + ns] = np.where(in_range, cnt, 0).astype(
-            np.float32
-        )
+        t = np.arange(nc)[:, None] - np.arange(ns)[None, :]  # i - j
+        cnt = np.minimum(alpha, t + beta) - np.maximum(0, t)
+        M[co:co + nc, so:so + ns] = np.maximum(cnt, 0).astype(np.float32)
         co += nc
         so += ns
     return M

@@ -1,26 +1,42 @@
 """Serving runtime: ragged paged-decode FFA + continuous batching.
 
-Layers (docs/serving.md):
+Layers (docs/serving.md, docs/serving_scale.md):
 
 - :mod:`.model` — the minimal deterministic model interface the engine
-  drives (q/k/v projection, output projection, autoregressive closure);
-- :mod:`.cache` — host page pool + slot lifecycle over the device-side
-  :class:`~..kernels.paged_kv.PagedKVCache`;
+  drives (q/k/v projection, output projection, autoregressive closure,
+  greedy self-draft for speculative decode);
+- :mod:`.cache` — host page pool (optionally partitioned into shards) +
+  slot lifecycle + residency accounting over the device-side
+  :class:`~..kernels.paged_kv.PagedKVCache` (f32 or int8+scales);
 - :mod:`.prefill` — chunked prompt ingestion through the existing FFA;
-- :mod:`.decode` — batched decode attention with the three-rung fallback
-  ladder (Pallas paged-decode kernel → gather+FFA → dense softmax);
-- :mod:`.scheduler` — FIFO admission, lazy page growth, LIFO eviction
-  with restart semantics under the page budget;
-- :mod:`.engine` — the continuous-batching tick loop + telemetry;
+- :mod:`.decode` — batched decode/verify attention with the registry
+  fallback ladder (sharded / speculative / int8 / base Pallas kernels →
+  gather+FFA → dense softmax);
+- :mod:`.scheduler` — FIFO admission with shard routing, lazy page
+  growth, LIFO eviction with restart semantics under the page budget,
+  page-level rollback shrink;
+- :mod:`.engine` — the continuous-batching tick loop (one token or a
+  spec_tokens draft window per tick) + telemetry;
 - :mod:`.reference` — sequential replay oracle for bitwise equality.
 """
 
-from .cache import PagePool, pages_needed, release_slot  # noqa: F401
-from .decode import decode_attn_step  # noqa: F401
+from .cache import (  # noqa: F401
+    PagePool,
+    kv_page_bytes,
+    pages_needed,
+    release_slot,
+    reset_page_scales,
+    slot_residency,
+)
+from .decode import decode_attn_step, verify_attn_step  # noqa: F401
 from .engine import ServeConfig, ServeEngine  # noqa: F401
 from .model import ToyModel  # noqa: F401
 from .prefill import prefill_request, prefill_schedule  # noqa: F401
-from .reference import generate_reference, run_reference  # noqa: F401
+from .reference import (  # noqa: F401
+    generate_reference,
+    oracle_draft_fn,
+    run_reference,
+)
 from .scheduler import Scheduler, ServeRequest  # noqa: F401
 
 __all__ = [
@@ -32,9 +48,14 @@ __all__ = [
     "ToyModel",
     "decode_attn_step",
     "generate_reference",
+    "kv_page_bytes",
+    "oracle_draft_fn",
     "pages_needed",
     "prefill_request",
     "prefill_schedule",
     "release_slot",
+    "reset_page_scales",
     "run_reference",
+    "slot_residency",
+    "verify_attn_step",
 ]

@@ -6,11 +6,21 @@ free, how many a request needs, and resetting a slot's table row when a
 request finishes or is evicted. Allocation order is deterministic (FIFO
 free list), which is what makes slot reuse and eviction replayable in
 tests.
+
+Sharding: when the decode kernel is mesh-sharded over kv heads
+(``paged_decode_attn_sharded``), every shard walks the same page ids — the
+head axis, not the page axis, is split. The pool still partitions its page
+ids into ``num_shards`` contiguous ranges with independent FIFO free lists
+so the scheduler can route each slot to the shard with the most headroom
+and keep per-shard HBM (each device materializes only its head slice of
+the pages it touches) balanced. ``num_shards=1`` is the exact PR 8 pool.
 """
 
 from __future__ import annotations
 
 from collections import deque
+
+import jax.numpy as jnp
 
 from ..kernels.paged_kv import PagedKVCache
 from ..resilience.errors import PageExhaustedError
@@ -22,37 +32,125 @@ def pages_needed(tokens: int, page_size: int) -> int:
     return max(1, -(-tokens // page_size))
 
 
-class PagePool:
-    """Deterministic FIFO free-list over the cache's page ids."""
+def kv_page_bytes(
+    page_size: int,
+    n_kv_heads: int,
+    head_dim: int,
+    head_dim_v: int | None = None,
+    kv_dtype: str = "float32",
+) -> int:
+    """HBM bytes one KV page costs under ``kv_dtype``, including the
+    per-(page, head) f32 scales a quantized cache carries. This is the
+    page-pool accounting behind the int8 residency claim: int8 pages cost
+    ~1/2 of bf16 (~1/4 of f32), so the same HBM budget holds >=2x the
+    slots."""
+    dv = head_dim if head_dim_v is None else head_dim_v
+    itemsize = {"int8": 1, "bfloat16": 2, "float16": 2, "float32": 4}[
+        str(kv_dtype)
+    ]
+    nbytes = page_size * n_kv_heads * (head_dim + dv) * itemsize
+    if kv_dtype == "int8":
+        nbytes += 2 * n_kv_heads * 4  # k_scales + v_scales rows
+    return nbytes
 
-    def __init__(self, num_pages: int) -> None:
+
+def slot_residency(
+    hbm_budget_bytes: int, page_bytes: int, pages_per_slot: int
+) -> int:
+    """How many full slots (``pages_per_slot`` pages each) fit in an HBM
+    budget — the denominator of the tokens/sec/chip lever int8 pulls."""
+    return hbm_budget_bytes // (page_bytes * pages_per_slot)
+
+
+class PagePool:
+    """Deterministic FIFO free-list over the cache's page ids, partitioned
+    into ``num_shards`` contiguous ranges (``num_shards=1`` = one list)."""
+
+    def __init__(self, num_pages: int, num_shards: int = 1) -> None:
+        if num_shards < 1 or num_pages % num_shards:
+            raise ValueError(
+                f"num_pages={num_pages} must split evenly over "
+                f"num_shards={num_shards}"
+            )
         self._num_pages = num_pages
-        self._free: deque[int] = deque(range(num_pages))
+        self._num_shards = num_shards
+        self._per_shard = num_pages // num_shards
+        self._free: list[deque[int]] = [
+            deque(range(s * self._per_shard, (s + 1) * self._per_shard))
+            for s in range(num_shards)
+        ]
 
     @property
     def num_pages(self) -> int:
         return self._num_pages
 
     @property
+    def num_shards(self) -> int:
+        return self._num_shards
+
+    @property
     def free_count(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
 
     @property
     def used_count(self) -> int:
-        return self._num_pages - len(self._free)
+        return self._num_pages - self.free_count
 
-    def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+    def shard_of(self, page_id: int) -> int:
+        """Which shard range a page id belongs to (release routing)."""
+        return page_id // self._per_shard
 
-    def alloc(self, n: int) -> list[int]:
-        """Pop ``n`` page ids; raises :class:`PageExhaustedError` when the
-        pool cannot cover them (callers decide whether to evict first)."""
-        if n > len(self._free):
-            raise PageExhaustedError(requested=n, free=len(self._free))
-        return [self._free.popleft() for _ in range(n)]
+    def free_count_shard(self, shard: int) -> int:
+        return len(self._free[shard])
+
+    def best_shard(self, n: int) -> int | None:
+        """Shard with the most free pages that can cover ``n`` (ties go to
+        the lowest id — deterministic routing); None if no single shard
+        can. A slot's pages all live on one shard, so admission is
+        per-shard even though the aggregate pool might cover ``n``."""
+        best, best_free = None, -1
+        for s in range(self._num_shards):
+            free = len(self._free[s])
+            if free >= n and free > best_free:
+                best, best_free = s, free
+        return best
+
+    def can_alloc(self, n: int, shard: int = 0) -> bool:
+        return n <= len(self._free[shard])
+
+    def alloc(self, n: int, shard: int = 0) -> list[int]:
+        """Pop ``n`` page ids from one shard's range; raises
+        :class:`PageExhaustedError` when that shard cannot cover them
+        (callers decide whether to evict first)."""
+        free = self._free[shard]
+        if n > len(free):
+            raise PageExhaustedError(requested=n, free=len(free))
+        return [free.popleft() for _ in range(n)]
 
     def release(self, page_ids: list[int]) -> None:
-        self._free.extend(page_ids)
+        for pid in page_ids:
+            self._free[self.shard_of(pid)].append(pid)
+
+
+def reset_page_scales(
+    cache: PagedKVCache, page_ids: list[int]
+) -> PagedKVCache:
+    """Zero the quantization scales of released pages so a reused page
+    quantizes exactly like a fresh one (scale growth is monotone within a
+    page's lifetime; without the reset, a predecessor's larger scale would
+    leak into the successor's codes and break the bitwise replay oracle).
+    No-op on float caches."""
+    if not cache.quantized or not page_ids:
+        return cache
+    idx = jnp.asarray(page_ids, jnp.int32)
+    return PagedKVCache(
+        cache.k_pages,
+        cache.v_pages,
+        cache.page_table,
+        cache.lengths,
+        cache.k_scales.at[idx].set(0.0),
+        cache.v_scales.at[idx].set(0.0),
+    )
 
 
 def release_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
@@ -65,4 +163,6 @@ def release_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
         cache.v_pages,
         cache.page_table.at[slot].set(-1),
         cache.lengths.at[slot].set(0),
+        cache.k_scales,
+        cache.v_scales,
     )

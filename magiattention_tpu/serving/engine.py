@@ -7,6 +7,23 @@ queries and length 0), then retire completed requests. Interleaving
 prefill and decode inside one tick is what "continuous batching" means
 here: a long prompt never stalls other requests for more than a tick.
 
+Scale knobs (docs/serving_scale.md), all off by default and composable:
+
+- ``spec_tokens`` k > 1 switches the decode phase to speculative verify:
+  each tick drafts k-1 extra inputs (``draft_fn``, default the model's
+  greedy self-draft), appends all k rows, verifies them in ONE
+  multi-row-q launch, commits the longest accepted prefix, and rolls the
+  rejected rows back (length + page-level — freed pages return to the
+  pool with their quantization scales reset). Because draft input 0 is
+  always the true ``pending_x``, at least one token commits per tick, and
+  commits are bitwise-identical to the one-token-per-tick engine.
+- ``kv_dtype='int8'`` stores KV pages quantized (per-page symmetric
+  scales), roughly quadrupling slots per HBM budget; decode runs the
+  dequant-in-kernel rung.
+- ``decode_shards`` > 1 runs the decode kernel under a kv-head
+  ``shard_map`` (one launch per device); ``pool_shards`` partitions the
+  page pool with per-shard routing in the scheduler.
+
 Every tick emits a ``serve_step`` telemetry record (docs/observability.md)
 when telemetry is enabled; wall-clock timing uses ``time.perf_counter``
 directly — serving/ is host orchestration, outside the kernels/functional
@@ -17,15 +34,16 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
+from typing import Callable
 
 import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
 from ..env import serve as env_serve
-from ..kernels.paged_kv import PagedKVCache, append_kv
+from ..kernels.paged_kv import PagedKVCache, append_kv, rollback_kv
 from .cache import PagePool
-from .decode import decode_attn_step
+from .decode import decode_attn_step, verify_attn_step
 from .model import ToyModel
 from .prefill import prefill_request
 from .scheduler import Scheduler, ServeRequest
@@ -45,6 +63,10 @@ class ServeConfig:
     max_pages_per_seq: int = 16
     prefill_chunk: int = 64
     softmax_scale: float | None = None
+    kv_dtype: str = "float32"  # 'float32' | 'int8'
+    spec_tokens: int = 1  # draft tokens verified per tick
+    decode_shards: int = 1  # kv-head mesh width for the decode kernel
+    pool_shards: int = 1  # page-pool partitions (scheduler routing)
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -55,15 +77,41 @@ class ServeConfig:
             max_slots=env_serve.serve_max_slots(),
             max_pages_per_seq=num_pages,
             prefill_chunk=env_serve.serve_prefill_chunk(),
+            kv_dtype=env_serve.serve_kv_dtype(),
+            spec_tokens=env_serve.serve_spec_tokens(),
+            decode_shards=env_serve.serve_shards(),
+            pool_shards=env_serve.serve_pool_shards(),
         )
+
+
+# draft_fn(model, request, current_input, draft_index) -> next draft input
+DraftFn = Callable[[ToyModel, ServeRequest, jnp.ndarray, int], jnp.ndarray]
+
+
+def _greedy_draft(
+    model: ToyModel, req: ServeRequest, x: jnp.ndarray, j: int
+) -> jnp.ndarray:
+    return model.draft_next(x)
 
 
 class ServeEngine:
     """Drives a :class:`ToyModel`-shaped model over a shared paged cache."""
 
-    def __init__(self, model: ToyModel, config: ServeConfig) -> None:
+    def __init__(
+        self,
+        model: ToyModel,
+        config: ServeConfig,
+        draft_fn: DraftFn | None = None,
+    ) -> None:
+        if config.kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"kv_dtype={config.kv_dtype!r} not in ('float32', 'int8')"
+            )
+        if config.spec_tokens < 1:
+            raise ValueError(f"spec_tokens={config.spec_tokens} must be >= 1")
         self.model = model
         self.config = config
+        self.draft_fn = draft_fn or _greedy_draft
         self.cache = PagedKVCache.create(
             num_pages=config.num_pages,
             page_size=config.page_size,
@@ -71,10 +119,12 @@ class ServeEngine:
             head_dim=model.head_dim,
             max_seqs=config.max_slots,
             max_pages_per_seq=config.max_pages_per_seq,
-            dtype=jnp.float32,
+            dtype=jnp.int8 if config.kv_dtype == "int8" else jnp.float32,
         )
         self.scheduler = Scheduler(
-            PagePool(config.num_pages), config.max_slots, config.page_size
+            PagePool(config.num_pages, config.pool_shards),
+            config.max_slots,
+            config.page_size,
         )
         self.step_count = 0
         self.finished: list[ServeRequest] = []
@@ -90,13 +140,16 @@ class ServeEngine:
 
     # -- one tick ---------------------------------------------------------
     def step(self) -> dict:
-        """Admit, prefill, decode one token per active slot, retire.
-        Returns the tick's stats dict (mirrors the telemetry record)."""
+        """Admit, prefill, decode (1 token or a spec_tokens draft window)
+        per active slot, retire. Returns the tick's stats dict (mirrors
+        the telemetry record)."""
         t0 = time.perf_counter()
         cfg = self.config
         sched = self.scheduler
+        spec_k = cfg.spec_tokens
         admitted = evicted = completed = 0
         prefill_tokens = decode_tokens = 0
+        draft_attempted = draft_accepted = 0
 
         # 1. admission + prefill
         self.cache, newly = sched.admit(self.cache)
@@ -118,42 +171,108 @@ class ServeEngine:
             if req is None or req.pending_x is None:
                 continue
             self.cache, n_evicted = sched.ensure_capacity(
-                self.cache, req, req.length + 1
+                self.cache, req, req.length + spec_k
             )
             evicted += n_evicted
 
-        # 3. decode one token per surviving slot
-        q_rows: dict[int, jnp.ndarray] = {}
-        for slot in range(cfg.max_slots):
-            req = sched.slots[slot]
-            if req is None or req.pending_x is None:
-                continue
-            q, k, v = self.model.qkv(req.pending_x[None])
-            self.cache = append_kv(self.cache, slot, k, v)
-            req.length += 1
-            q_rows[slot] = q[0]
-            decode_tokens += 1
-
-        if q_rows:
-            hq, d = self.model.n_heads, self.model.head_dim
-            zero_row = jnp.zeros((hq, d), jnp.float32)
-            q_batch = jnp.stack(
-                [q_rows.get(s, zero_row) for s in range(cfg.max_slots)]
-            )
-            host_lengths = tuple(
-                sched.slots[s].length if s in q_rows else 0
-                for s in range(cfg.max_slots)
-            )
-            out, _ = decode_attn_step(
-                q_batch, self.cache, host_lengths, cfg.softmax_scale
-            )
-            for slot in sorted(q_rows):
+        # 3. decode: one token (spec_k == 1) or draft+verify (spec_k > 1)
+        # per surviving slot
+        if spec_k == 1:
+            q_rows: dict[int, jnp.ndarray] = {}
+            for slot in range(cfg.max_slots):
                 req = sched.slots[slot]
-                hidden = self.model.project(out[slot : slot + 1])[0]
-                req.generated.append(np.asarray(hidden))
-                if req.first_token_time is None:
-                    req.first_token_time = time.perf_counter()
-                req.pending_x = self.model.next_input(hidden)
+                if req is None or req.pending_x is None:
+                    continue
+                q, k, v = self.model.qkv(req.pending_x[None])
+                self.cache = append_kv(self.cache, slot, k, v)
+                req.length += 1
+                q_rows[slot] = q[0]
+                decode_tokens += 1
+                draft_attempted += 1
+                draft_accepted += 1
+
+            if q_rows:
+                hq, d = self.model.n_heads, self.model.head_dim
+                zero_row = jnp.zeros((hq, d), jnp.float32)
+                q_batch = jnp.stack(
+                    [q_rows.get(s, zero_row) for s in range(cfg.max_slots)]
+                )
+                host_lengths = tuple(
+                    sched.slots[s].length if s in q_rows else 0
+                    for s in range(cfg.max_slots)
+                )
+                out, _ = decode_attn_step(
+                    q_batch, self.cache, host_lengths, cfg.softmax_scale,
+                    shards=cfg.decode_shards,
+                )
+                for slot in sorted(q_rows):
+                    req = sched.slots[slot]
+                    hidden = self.model.project(out[slot : slot + 1])[0]
+                    req.generated.append(np.asarray(hidden))
+                    if req.first_token_time is None:
+                        req.first_token_time = time.perf_counter()
+                    req.pending_x = self.model.next_input(hidden)
+        else:
+            q_tiles: dict[int, jnp.ndarray] = {}
+            draft_xs: dict[int, list] = {}
+            for slot in range(cfg.max_slots):
+                req = sched.slots[slot]
+                if req is None or req.pending_x is None:
+                    continue
+                xs = [req.pending_x]
+                for j in range(1, spec_k):
+                    xs.append(self.draft_fn(self.model, req, xs[-1], j))
+                x_block = jnp.stack(xs)  # (spec_k, d_model)
+                q, k, v = self.model.qkv(x_block)
+                self.cache = append_kv(self.cache, slot, k, v)
+                req.length += spec_k
+                q_tiles[slot] = q  # (spec_k, hq, d)
+                draft_xs[slot] = xs
+                draft_attempted += spec_k
+
+            if q_tiles:
+                hq, d = self.model.n_heads, self.model.head_dim
+                zero_tile = jnp.zeros((spec_k, hq, d), jnp.float32)
+                q_batch = jnp.stack(
+                    [q_tiles.get(s, zero_tile) for s in range(cfg.max_slots)]
+                )
+                host_lengths = tuple(
+                    sched.slots[s].length if s in q_tiles else 0
+                    for s in range(cfg.max_slots)
+                )
+                out, _ = verify_attn_step(
+                    q_batch, self.cache, host_lengths, cfg.softmax_scale
+                )
+                for slot in sorted(q_tiles):
+                    req = sched.slots[slot]
+                    # (spec_k, d_model) — row j is correct iff draft inputs
+                    # 0..j were (causal rows never see later garbage)
+                    hiddens = self.model.project(out[slot])
+                    xs = draft_xs[slot]
+                    # longest accepted prefix: draft 0 is the true
+                    # pending_x, so row 0 is always right; row j commits
+                    # iff its input equals what row j-1's output implies
+                    accept = 1
+                    while accept < spec_k and np.array_equal(
+                        np.asarray(xs[accept]),
+                        np.asarray(self.model.next_input(hiddens[accept - 1])),
+                    ):
+                        accept += 1
+                    remaining = req.max_new_tokens - len(req.generated)
+                    commit = min(accept, remaining)
+                    for j in range(commit):
+                        req.generated.append(np.asarray(hiddens[j]))
+                    if req.first_token_time is None:
+                        req.first_token_time = time.perf_counter()
+                    req.pending_x = self.model.next_input(hiddens[commit - 1])
+                    decode_tokens += commit
+                    draft_accepted += commit
+                    if commit < spec_k:  # rollback rejected rows + pages
+                        req.length -= spec_k - commit
+                        self.cache = rollback_kv(
+                            self.cache, slot, req.length
+                        )
+                        self.cache = sched.shrink_to_length(self.cache, req)
 
         # 4. retirement
         for slot in range(cfg.max_slots):
@@ -176,6 +295,14 @@ class ServeEngine:
             completed=completed,
             prefill_tokens=prefill_tokens,
             decode_tokens=decode_tokens,
+            kv_dtype=cfg.kv_dtype,
+            shards=cfg.decode_shards,
+            spec_k=spec_k,
+            draft_attempted=draft_attempted,
+            draft_accepted=draft_accepted,
+            accept_rate=(
+                draft_accepted / draft_attempted if draft_attempted else 0.0
+            ),
         )
         if telemetry.enabled():
             telemetry.record_event("serve_step", **stats)

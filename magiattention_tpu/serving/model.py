@@ -81,6 +81,18 @@ class ToyModel:
         generations stay finite)."""
         return jnp.tanh(hidden)
 
+    def draft_next(self, x: jax.Array) -> jax.Array:
+        """Greedy zero-context draft of the next input: run the layer as if
+        ``x`` were the only token (softmax over one position makes the
+        attention output just ``v``), then close the loop with
+        ``next_input``. Cheap (no cache access), deterministic, and right
+        whenever attention is locally dominated by the current token — the
+        speculative-verify accept rate measures exactly how often."""
+        _, _, v = self.qkv(x[None])  # v: (1, hk, d)
+        g = self.n_heads // self.n_kv_heads
+        out = jnp.repeat(v[0], g, axis=0)[None]  # (1, hq, d)
+        return self.next_input(self.project(out)[0])
+
     def prompt(self, length: int, seed: int) -> jax.Array:
         """A deterministic synthetic prompt ``(length, d_model)``."""
         rng = np.random.default_rng(seed)

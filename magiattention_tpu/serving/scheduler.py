@@ -28,7 +28,7 @@ import numpy as np
 
 from ..kernels.paged_kv import PagedKVCache, assign_pages
 from ..resilience.errors import PageExhaustedError
-from .cache import PagePool, pages_needed, release_slot
+from .cache import PagePool, pages_needed, release_slot, reset_page_scales
 
 
 @dataclass
@@ -47,6 +47,7 @@ class ServeRequest:
     pending_x: jax.Array | None = None  # next decode step's input row
     admit_seq: int = -1
     evictions: int = 0
+    shard: int = 0  # page-pool shard all of this request's pages live on
 
     # latency bookkeeping (serve_bench)
     submit_time: float | None = None
@@ -68,6 +69,7 @@ class ServeRequest:
         self.length = 0
         self.generated = []
         self.pending_x = None
+        self.shard = 0
 
 
 class Scheduler:
@@ -113,10 +115,12 @@ class Scheduler:
                     f"request {req.req_id}: prompt needs {need} pages, "
                     f"table width is {cache.page_table.shape[1]}"
                 )
-            if not self.pool.can_alloc(need):
+            shard = self.pool.best_shard(need)
+            if shard is None:
                 break
             self.waiting.popleft()
-            req.page_ids = self.pool.alloc(need)
+            req.shard = shard
+            req.page_ids = self.pool.alloc(need, shard=shard)
             req.slot = slot
             req.length = 0
             req.admit_seq = self._admit_counter
@@ -142,9 +146,9 @@ class Scheduler:
             )
         while len(req.page_ids) < need:
             try:
-                new_pages = self.pool.alloc(1)
+                new_pages = self.pool.alloc(1, shard=req.shard)
             except PageExhaustedError:
-                cache = self.evict_one(cache, exclude=req)
+                cache = self.evict_one(cache, exclude=req, shard=req.shard)
                 evicted += 1
                 continue
             req.page_ids.extend(new_pages)
@@ -152,23 +156,62 @@ class Scheduler:
         return cache, evicted
 
     def evict_one(
-        self, cache: PagedKVCache, exclude: ServeRequest
+        self,
+        cache: PagedKVCache,
+        exclude: ServeRequest,
+        shard: int | None = None,
     ) -> PagedKVCache:
         """Restart the most-recently-admitted active request other than
-        ``exclude``; raises :class:`PageExhaustedError` when none exists."""
+        ``exclude`` (on ``shard`` when given — growth can only use its own
+        shard's pages); raises :class:`PageExhaustedError` when none
+        exists."""
         victims = [
-            r for r in self.slots if r is not None and r is not exclude
+            r
+            for r in self.slots
+            if r is not None
+            and r is not exclude
+            and (shard is None or r.shard == shard)
         ]
         if not victims:
-            raise PageExhaustedError(requested=1, free=self.pool.free_count)
+            free = (
+                self.pool.free_count
+                if shard is None
+                else self.pool.free_count_shard(shard)
+            )
+            raise PageExhaustedError(requested=1, free=free)
         victim = max(victims, key=lambda r: r.admit_seq)
         self.pool.release(victim.page_ids)
+        cache = reset_page_scales(cache, victim.page_ids)
         cache = release_slot(cache, victim.slot)
         self.slots[victim.slot] = None
         victim.reset_runtime()
         victim.evictions += 1
         self.waiting.appendleft(victim)
         return cache
+
+    def shrink_to_length(
+        self, cache: PagedKVCache, req: ServeRequest
+    ) -> PagedKVCache:
+        """Release pages past ``pages_needed(req.length)`` back to the pool
+        (speculative-verify page-level rollback). The table entries beyond
+        the kept prefix go back to -1 so a re-grown request re-installs
+        fresh ids, and released quantized pages get their scales reset."""
+        need = pages_needed(req.length, self.page_size)
+        extra = req.page_ids[need:]
+        if not extra:
+            return cache
+        req.page_ids = req.page_ids[:need]
+        self.pool.release(extra)
+        cache = reset_page_scales(cache, extra)
+        cache = PagedKVCache(
+            cache.k_pages,
+            cache.v_pages,
+            cache.page_table.at[req.slot].set(-1),
+            cache.lengths,
+            cache.k_scales,
+            cache.v_scales,
+        )
+        return assign_pages(cache, req.slot, req.page_ids)
 
     # -- completion -------------------------------------------------------
     def finish(
@@ -177,6 +220,7 @@ class Scheduler:
         """Free a completed request's resources (its outputs stay on the
         request object)."""
         self.pool.release(req.page_ids)
+        cache = reset_page_scales(cache, req.page_ids)
         cache = release_slot(cache, req.slot)
         self.slots[req.slot] = None
         req.page_ids = []

@@ -89,11 +89,12 @@ def ffa_kernel_residency(
     except dkv's lse/delta sublane layout which is group-independent.
     """
     if kind not in (
-        "fwd", "dq", "dkv", "fused", "delta", "decode", "bsp_fwd", "bsp_bwd"
+        "fwd", "dq", "dkv", "fused", "delta", "decode", "decode_spec",
+        "decode_int8", "bsp_fwd", "bsp_bwd",
     ):
         raise ValueError(
             f"kind must be 'fwd'|'dq'|'dkv'|'fused'|'delta'|'decode'|"
-            f"'bsp_fwd'|'bsp_bwd', got {kind!r}"
+            f"'decode_spec'|'decode_int8'|'bsp_fwd'|'bsp_bwd', got {kind!r}"
         )
     dv = head_dim_v or head_dim
     g = group if packed else 1
@@ -144,15 +145,30 @@ def ffa_kernel_residency(
         blocks += bq * 128 * f32  # delta (lanes-broadcast)
         scratch = 0
         inter = bq * dv * f32  # fp32 elementwise product
-    elif kind in ("decode", "bsp_fwd"):
+    elif kind in ("decode", "decode_spec", "bsp_fwd"):
         # decode (kernels/paged_decode.py): bq = GQA group rows of one kv
-        # head, bk = page_size. bsp_fwd (kernels/block_sparse.py): bq =
-        # block_size_q * group rows of one q block, bk = d_stride chunk
-        # rows. Identical residency shape: q tile, one streamed k/v chunk,
-        # out + lanes-broadcast lse, m/l/acc scratch (group/packed/emit_ml
-        # are ignored).
+        # head, bk = page_size. decode_spec (the speculative-verify
+        # variant): identical shape with bq = spec_k * group rows — the
+        # draft window rides the q tile. bsp_fwd (kernels/block_sparse.py):
+        # bq = block_size_q * group rows of one q block, bk = d_stride
+        # chunk rows. Identical residency shape: q tile, one streamed k/v
+        # chunk, out + lanes-broadcast lse, m/l/acc scratch
+        # (group/packed/emit_ml are ignored).
         blocks = bq * d * dtype_bytes  # q group tile
         blocks += bk * d * dtype_bytes + bk * dv * dtype_bytes  # one k/v page
+        blocks += bq * dv * dtype_bytes  # out
+        blocks += bq * 128 * f32  # lse (lanes-broadcast)
+        scratch = (2 * bq * 128 + bq * dv) * f32  # m, l, acc
+        inter = bq * bk * f32  # s (p reuses its storage)
+    elif kind == "decode_int8":
+        # int8-KV decode (kernels/paged_decode.py): k/v pages are int8
+        # codes (1 byte/elem regardless of the compute dtype), each riding
+        # a (1, 1) f32 per-(page, head) scale block on the same page-table
+        # prefetch; q/out stay at the compute dtype. Dequant is in-kernel,
+        # so scratch/intermediates match the base decode shape.
+        blocks = bq * d * dtype_bytes  # q group tile
+        blocks += bk * d + bk * dv  # one int8 k/v page (1 byte/elem)
+        blocks += 2 * f32  # k + v per-page scale blocks
         blocks += bq * dv * dtype_bytes  # out
         blocks += bq * 128 * f32  # lse (lanes-broadcast)
         scratch = (2 * bq * 128 + bq * dv) * f32  # m, l, acc

@@ -148,6 +148,13 @@ SECTION_SCHEMAS: dict[str, dict[str, str]] = {
         "pages_in_use_max": "peak KV pages in use",
         "wall_ms_mean": "mean step wall (ms)",
         "wall_ms_max": "max step wall (ms)",
+        "kv_dtype": "KV cache dtype, last step",
+        "shards": "decode kv-head mesh width, last step",
+        "spec_k": "draft tokens verified per tick, last step",
+        "draft_attempted_total": "speculative draft rows attempted",
+        "draft_accepted_total": "speculative draft rows committed",
+        "accept_rate": "accepted / attempted draft rows",
+        "accepted_per_tick": "committed tokens per decoding tick",
     },
     "nsa": {
         "steps": "nsa_step records",
@@ -454,6 +461,21 @@ def aggregate(records: list[dict]) -> dict:
             "wall_ms_mean": sum(walls) / len(walls) if walls else None,
             "wall_ms_max": max(walls) if walls else None,
         }
+        # serving-scale stamps (kv_dtype / shards / spec_k are config-
+        # static per engine, so 'last' == the run's setting; accept stats
+        # aggregate over every tick that decoded)
+        attempted = sum(s.get("draft_attempted", 0) for s in serves)
+        accepted = sum(s.get("draft_accepted", 0) for s in serves)
+        ticks = sum(1 for s in serves if s.get("draft_attempted", 0))
+        agg["serve"].update({
+            "kv_dtype": serves[-1].get("kv_dtype"),
+            "shards": serves[-1].get("shards"),
+            "spec_k": serves[-1].get("spec_k"),
+            "draft_attempted_total": attempted,
+            "draft_accepted_total": accepted,
+            "accept_rate": accepted / attempted if attempted else None,
+            "accepted_per_tick": accepted / ticks if ticks else None,
+        })
 
     nsa = kinds.get("nsa_step", [])
     if nsa:
@@ -823,6 +845,18 @@ def format_summary(agg: dict) -> str:
             lines.append(
                 f"  wall per step: mean={sv['wall_ms_mean']:.1f} ms "
                 f"max={sv['wall_ms_max']:.1f} ms"
+            )
+        if sv.get("kv_dtype") is not None:
+            lines.append(
+                f"  scale: kv_dtype={sv['kv_dtype']} shards={sv['shards']} "
+                f"spec_k={sv['spec_k']}"
+            )
+        if sv.get("accept_rate") is not None:
+            lines.append(
+                f"  speculative: accepted={sv['draft_accepted_total']}/"
+                f"{sv['draft_attempted_total']} "
+                f"(rate {sv['accept_rate']:.2f}, "
+                f"{sv['accepted_per_tick']:.2f} tok/tick)"
             )
 
     ns = agg.get("nsa")

@@ -130,6 +130,17 @@ run_queue() {
   run_step 1800 ".tpu_logs/${TS}_balance.log" python -u scripts/tpu_rank_balance.py || return
   # serving path: paged-KV decode latency at 256/4k/8k/32k context
   run_step 900 ".tpu_logs/${TS}_decode.log" python -u scripts/tpu_decode_probe.py || return
+  # serving-scale A/B — base vs speculative vs int8 vs kv-head-sharded
+  # decode backends, one bench_serve.csv config group each. Pre-registered
+  # expectation: int8 holds ~2x the slots per HBM budget at comparable
+  # decode rate (quantization is in-kernel); spec lifts
+  # accepted_per_tick_rate above 1.0 at its measured accept_rate; the
+  # sharded arm falls back to the single-chip kernel unless the tunnel
+  # exposes >= 2 devices (the feasibility filter makes that safe to queue)
+  run_step 900 ".tpu_logs/${TS}_serve_base.log" python -u benchmarks/serve_bench.py --requests 16 || return
+  run_step 900 ".tpu_logs/${TS}_serve_spec.log" python -u benchmarks/serve_bench.py --requests 16 --spec-tokens 2 || return
+  run_step 900 ".tpu_logs/${TS}_serve_int8.log" python -u benchmarks/serve_bench.py --requests 16 --kv-dtype int8 || return
+  run_step 900 ".tpu_logs/${TS}_serve_sharded.log" python -u benchmarks/serve_bench.py --requests 16 --shards 2 || return
   # chip-static calibration (matmul ceiling, launch overhead, bundled A/B)
   run_step 1200 ".tpu_logs/${TS}_calibrate.log" python -u scripts/tpu_calibrate.py || return
   run_step 900 ".tpu_logs/${TS}_overlap.log" python -u scripts/tpu_overlap_tax.py || return

@@ -36,7 +36,7 @@ from magiattention_tpu.analysis.violation import VerifyReport
 
 def test_discovery_finds_every_pallas_site():
     sites = discover_pallas_sites()
-    assert len(sites) == 12
+    assert len(sites) == 14
     names = {s.kernel_name for s in sites}
     assert names == set(_pallas_contracts())
     assert {s.relpath for s in sites} == {
@@ -107,13 +107,13 @@ def test_k5_allowlist_entries_carry_a_proof():
 
 def test_seeded_mutations_fire_exactly_their_rule():
     results = run_seeded_mutations()
-    assert len(results) == 9
+    assert len(results) == 10
     assert {r["expected_rule"] for r in results} == {
         "K1", "K2", "K3", "K4", "K5"
     }
     assert {r["mutation"] for r in results} >= {
         "corrupted_extent_row", "deleted_revisit_init", "oob_page_table",
-        "oob_block_table",
+        "oob_block_table", "misrouted_scale_prefetch",
     }
     for r in results:
         assert r["ok"], (
@@ -157,16 +157,25 @@ def test_smoke_audit_covers_all_kernels_and_reports_vmem(smoke_audit):
 
 
 def test_decode_corpus_contracts_are_clean():
-    # the paged-decode kernel joins the audit corpus: every config must
-    # capture exactly one contract and pass K1/K3/K4 on it
+    # the paged-decode kernel family joins the audit corpus: every config
+    # must capture exactly one contract (of its variant's kernel) and pass
+    # K1/K3/K4 on it
+    expected = {
+        "base": "_paged_decode_kernel",
+        "spec": "_paged_decode_spec_kernel",
+        "int8": "_paged_decode_int8_kernel",
+    }
+    seen = set()
     for dspec in decode_corpus():
         contracts = capture_decode_contracts(dspec)
-        assert [c.kernel_name for c in contracts] == ["_paged_decode_kernel"]
+        assert [c.kernel_name for c in contracts] == [expected[dspec.variant]]
+        seen.add(dspec.variant)
         report = VerifyReport()
         check_contract(report, contracts[0], dspec.name)
         assert report.fired_rules() == set(), "\n".join(
             str(v) for v in report.violations
         )
+    assert seen == set(expected)
 
 
 def test_check_contract_is_deterministic(smoke_audit):

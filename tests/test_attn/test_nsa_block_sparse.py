@@ -1,8 +1,8 @@
 """NSA baseline parity: ``nsa_attn`` vs a per-segment numpy reference
 across cu_seqlens layouts x GQA groups x dtypes, the gather-free
 block-sparse slc branch vs the gathered-dense reference (fwd allclose +
-vjp parity), and the vectorized ``_p_slc_matrix`` vs its loop original
-(bitwise)."""
+vjp parity), and the vectorized ``_p_slc_matrix`` vs a brute-force
+chunk-walk loop oracle (bitwise)."""
 
 import numpy as np
 import pytest
@@ -36,18 +36,26 @@ CU_LAYOUTS = [
 
 
 def _p_slc_matrix_loop(counts_cmp, counts_slc, l_slc, l_cmp, d):
-    """The pre-vectorization quadruple loop, kept verbatim as the oracle."""
+    """Brute-force chunk-walk oracle for the stride-``d`` overlap weights.
+
+    Both block families are ``_block_layout`` windows anchored at stride
+    ``d``: cmp block i covers d-chunks ``[i, i + beta)``, slc block j
+    covers ``[j, j + alpha)``. The weight is their shared-chunk count,
+    accumulated one chunk at a time — structurally independent of the
+    vectorized closed form ``max(0, min(i+beta, j+alpha) - max(i, j))``
+    in ``_p_slc_matrix``. (The old stride-``l_slc`` anchoring,
+    ``idx = alpha*j - m - n``, scored slc windows from the wrong cmp
+    blocks; see the misaligned-stride parity test below.)"""
     alpha, beta = l_slc // d, l_cmp // d
     n_cmp, n_slc = sum(counts_cmp), sum(counts_slc)
     M = np.zeros((n_cmp, n_slc), dtype=np.float32)
     co = so = 0
     for nc, ns in zip(counts_cmp, counts_slc):
         for j in range(ns):
-            for m in range(alpha):
-                for n in range(beta):
-                    idx = alpha * j - m - n
-                    if 0 <= idx < nc:
-                        M[co + idx, so + j] += 1.0
+            for c in range(j, j + alpha):  # d-chunks of slc window j
+                for i in range(nc):  # cmp blocks whose window holds chunk c
+                    if i <= c < i + beta:
+                        M[co + i, so + j] += 1.0
         co += nc
         so += ns
     return M

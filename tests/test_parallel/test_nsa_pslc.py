@@ -1,0 +1,86 @@
+"""cmp->slc aggregation-matrix parity (parallel/nsa._p_slc_matrix).
+
+Both block families come from ``_block_layout`` and are therefore anchored
+at stride ``d_stride``; the aggregation weight must be the geometric
+chunk-overlap count of the two windows. These tests pin that against a
+brute-force row-overlap oracle and against the misaligned-stride needle
+that exposed the original bug (slc windows scored by cmp blocks they do
+not even overlap). Pure host numpy — tier-1 fast.
+"""
+
+import numpy as np
+
+from magiattention_tpu.parallel.nsa import _block_layout, _p_slc_matrix
+
+
+def _overlap_oracle(cu, l_slc, l_cmp, d):
+    """Brute-force: count of shared stride-d chunks between every
+    (cmp window, slc window) pair of the SAME _block_layout geometry the
+    runtime uses, zero across segments."""
+    cmp_starts, cmp_seg, _ = _block_layout(cu, l_cmp, d)
+    slc_starts, slc_seg, _ = _block_layout(cu, l_slc, d)
+    M = np.zeros((len(cmp_starts), len(slc_starts)), dtype=np.float32)
+    for i, (cs, cseg) in enumerate(zip(cmp_starts, cmp_seg)):
+        for j, (ss, sseg) in enumerate(zip(slc_starts, slc_seg)):
+            if cseg != sseg:
+                continue
+            lo = max(cs, ss)
+            hi = min(cs + l_cmp, ss + l_slc)
+            M[i, j] = max(0, hi - lo) // d
+    return M
+
+
+def test_matrix_matches_overlap_oracle_across_geometries():
+    for l_cmp, l_slc, d in [
+        (32, 64, 32),   # alpha=2, beta=1: the serving default shape
+        (16, 32, 16),   # the nsa test-corpus shape
+        (64, 64, 32),   # alpha=beta=2: symmetric overlap
+        (64, 128, 32),  # alpha=4, beta=2
+        (32, 96, 32),   # alpha=3, beta=1
+    ]:
+        for cu in ([0, 256], [0, 128, 256], [0, 192, 448]):
+            _, _, cmp_counts = _block_layout(cu, l_cmp, d)
+            _, _, slc_counts = _block_layout(cu, l_slc, d)
+            got = _p_slc_matrix(cmp_counts, slc_counts, l_slc, l_cmp, d)
+            want = _overlap_oracle(cu, l_slc, l_cmp, d)
+            np.testing.assert_array_equal(
+                got, want,
+                err_msg=f"l_cmp={l_cmp} l_slc={l_slc} d={d} cu={cu}",
+            )
+
+
+def test_identity_when_all_strides_equal():
+    # alpha == beta == 1 must reduce to the identity — the same condition
+    # under which nsa_attn shortcuts to p_slc = p_cmp, so both paths agree
+    cu = [0, 128, 256]
+    _, _, counts = _block_layout(cu, 32, 32)
+    M = _p_slc_matrix(counts, counts, 32, 32, 32)
+    np.testing.assert_array_equal(M, np.eye(sum(counts), dtype=np.float32))
+
+
+def test_misaligned_stride_needle_selects_covering_window():
+    """The bug shape: l_slc = 2 * d_stride, l_cmp = d_stride (alpha=2,
+    beta=1). A needle of attention mass on cmp block i must boost exactly
+    the slc windows that contain chunk i — j in {i-1, i}. The old
+    stride-l_slc anchoring credited j ~ i/2 instead: for i=7 that selects
+    the window over rows [4d, 6d), which does not even contain the needle
+    chunk at [7d, 8d)."""
+    l_cmp, l_slc, d = 32, 64, 32
+    cu = [0, 320]  # 10 cmp chunks, 9 overlapping slc windows
+    _, _, cmp_counts = _block_layout(cu, l_cmp, d)
+    _, _, slc_counts = _block_layout(cu, l_slc, d)
+    M = _p_slc_matrix(cmp_counts, slc_counts, l_slc, l_cmp, d)
+
+    i = 7
+    p_cmp = np.zeros(sum(cmp_counts), dtype=np.float32)
+    p_cmp[i] = 1.0
+    score = p_cmp @ M  # per-slc-window selection score
+    hot = set(np.nonzero(score > 0)[0].tolist())
+    assert hot == {i - 1, i}, hot
+    # every boosted window really covers the needle's rows
+    slc_starts, _, _ = _block_layout(cu, l_slc, d)
+    for j in hot:
+        assert slc_starts[j] <= i * d < slc_starts[j] + l_slc
+    # and the old anchoring's pick (j = floor(alpha*j == i) ~ 3..4) is
+    # provably needle-free
+    assert 3 not in hot and slc_starts[3] + l_slc <= i * d

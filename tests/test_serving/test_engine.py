@@ -137,8 +137,14 @@ def test_serve_step_telemetry_round_trip(model, monkeypatch, tmp_path):
         r.max_new_tokens for r in requests
     )
     assert 0.0 < sv["occupancy_mean"] <= 1.0
+    # scale stamps: a one-token-per-tick f32 engine accepts every "draft"
+    assert sv["kv_dtype"] == "float32"
+    assert sv["shards"] == 1 and sv["spec_k"] == 1
+    assert sv["accept_rate"] == 1.0
+    assert sv["accepted_per_tick"] >= 1.0
     text = mod.format_summary(agg)
     assert "serving steps=" in text and "tokens: prefill=" in text
+    assert "scale: kv_dtype=float32" in text
 
 
 def test_telemetry_off_is_zero_overhead(model, monkeypatch):

@@ -161,10 +161,14 @@ def test_calc_attn_backend_pin(monkeypatch):
 def test_ladders_expose_fallback_order():
     assert kreg.ladder("calc_attn") == ("ffa", "sdpa", "sdpa_online")
     assert kreg.ladder("serve_decode") == (
+        "paged_decode_sharded", "paged_decode_spec", "paged_decode_int8",
+        "paged_decode", "gather_ffa", "dense")
+    assert kreg.ladder("serve_decode", "paged_decode") == (
         "paged_decode", "gather_ffa", "dense")
     assert kreg.ladder("serve_decode", "gather_ffa") == (
         "gather_ffa", "dense")
     assert kreg.ladder("serve_decode", "unknown") == (
+        "paged_decode_sharded", "paged_decode_spec", "paged_decode_int8",
         "paged_decode", "gather_ffa", "dense")
     # the resilience module's reference rung is the calc_attn ladder's last
     from magiattention_tpu.resilience.fallback import reference_backend
