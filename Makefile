@@ -5,9 +5,9 @@ PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
 	telemetry-smoke analysis lint verify-plans kernel-audit chaos \
-	serve-smoke perf-gate nsa-needle-smoke
+	serve-smoke perf-gate nsa-needle-smoke plan-cache-smoke
 
-test: analysis chaos serve-smoke  ## fast tier: the correctness surface in < 5 min on one core
+test: analysis chaos serve-smoke plan-cache-smoke  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 test-all: analysis  ## everything: + model training, scale oracles, property suites
@@ -61,3 +61,6 @@ nsa-needle-smoke:  ## needle-in-haystack retrieval through the gather-free NSA k
 
 serve-smoke:  ## CPU continuous-batching end-to-end: engine bitwise vs replay
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
+
+plan-cache-smoke:  ## two-process plan-store proof: warm start with zero solves + corruption heal
+	JAX_PLATFORMS=cpu $(PY) scripts/plan_cache_smoke.py

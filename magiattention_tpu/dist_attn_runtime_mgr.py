@@ -29,6 +29,7 @@ from .meta import (
     make_attn_meta_from_dispatch_meta,
     make_dispatch_meta_from_qk_ranges,
 )
+from .meta import plan_broadcast, plan_io, plan_store
 
 
 def _plan_build_retries() -> int:
@@ -180,6 +181,190 @@ class _PlanCache:
 _PLAN_CACHE = _PlanCache()
 
 
+# ---------------------------------------------------------------------------
+# plan control plane: memory LRU -> disk store -> broadcast -> cold solve
+# (docs/plan_control_plane.md). Every tier below memory is byte-serialized
+# (meta/plan_io.py), so every loaded entry is integrity-checked at decode
+# and re-verified by R1-R5/check_hier_plan before first use. Every failure
+# on the way down the ladder is a recorded miss, never a crash — the cold
+# solver is always reachable.
+# ---------------------------------------------------------------------------
+
+
+def _chaos_miss(site: str, err: Exception) -> None:
+    """Recover-or-typed-raise for an InjectedFault at a control-plane site:
+    with MAGI_ATTENTION_FALLBACK=1 the fault becomes a recorded miss, else
+    it propagates typed (the standard chaos contract)."""
+    if not env_resilience.is_fallback_enable():
+        raise err
+    from .resilience.fallback import record_resilience_event
+
+    record_resilience_event(
+        "fallback", getattr(err, "site", site),
+        action_detail="cold_solve", error=type(err).__name__,
+    )
+
+
+def _verify_loaded_entry(entry: dict, key: DistAttnRuntimeKey) -> bool:
+    """R1-R5 (+ check_hier_plan for two-level stages) over a disk/wire
+    loaded entry — unconditional, unlike MAGI_ATTENTION_VERIFY_PLANS: a
+    deserialized plan is only trusted after it verifies exactly like a
+    cold-solved one. Any verifier error (or malformed entry) rejects the
+    entry back to a miss."""
+    from .analysis.verifier import verify_dynamic_plan, verify_plan
+
+    align = key.config.grpcoll_config.split_alignment
+    try:
+        meta_q, meta_kv, bucket = entry["dispatch"]
+        dynamic = entry.get("dynamic")
+        if dynamic is not None and not verify_dynamic_plan(
+            dynamic, split_alignment=align
+        ).ok():
+            return False
+        static = entry.get("static")
+        comm_meta, calc_meta = static if static is not None else (None, None)
+        report = verify_plan(
+            dispatch_meta=meta_q,
+            bucket=bucket,
+            comm_meta=comm_meta,
+            calc_meta=calc_meta,
+            dispatch_meta_kv=(meta_kv if meta_kv is not meta_q else None),
+            split_alignment=align,
+        )
+        return report.ok()
+    except Exception:
+        return False
+
+
+def _reject_loaded_entry(site: str, reason: str) -> None:
+    from .resilience.fallback import record_resilience_event
+
+    record_resilience_event("reject", site, reason=reason)
+
+
+def _control_plane_lookup(
+    sig: tuple, key: DistAttnRuntimeKey, entry: dict | None, source: str
+) -> tuple[dict | None, str, dict]:
+    """Run the disk + broadcast tiers for one plan resolution.
+
+    ``entry``/``source`` are the memory tier's result; returns the
+    (possibly upgraded) ``(entry, source, telemetry_extra)``. Loaded
+    entries are verified here; a broadcast-received entry is written
+    through to the disk store so later processes warm-start locally."""
+    env_sig = key.env_snapshot
+    digest: str | None = None
+    extra: dict = {}
+
+    store = plan_store.get_store()
+    if entry is None and store is not None:
+        digest = plan_io.plan_signature_digest(sig)
+        try:
+            candidate, miss = store.read(digest, env_sig=env_sig)
+        except Exception as e:
+            from .resilience.errors import InjectedFault
+
+            if not isinstance(e, InjectedFault):
+                raise
+            _chaos_miss("plan_cache_read", e)
+            candidate, miss = None, None
+        if candidate is not None:
+            if _verify_loaded_entry(candidate, key):
+                entry, source = candidate, "disk"
+            else:
+                _reject_loaded_entry("plan_cache_read", plan_store.MISS_VERIFY)
+
+    transport = plan_broadcast.get_transport()
+    if transport is None:
+        return entry, source, extra
+    leader = plan_broadcast.is_leader()
+    multihost = isinstance(transport, plan_broadcast.MultihostTransport)
+    if digest is None:
+        digest = plan_io.plan_signature_digest(sig)
+    if leader:
+        # the multihost transport is collective — the leader must exchange
+        # on EVERY resolution (hits included) so follower receive counts
+        # align; a cold leader publishes later, in _persist_entry
+        if multihost and entry is not None:
+            _persist_entry(sig, key, entry, store=None)
+        return entry, source, extra
+    if entry is not None and not multihost:
+        return entry, source, extra
+    try:
+        result = plan_broadcast.exchange_plan(digest, None)
+    except Exception as e:
+        from .resilience.errors import InjectedFault
+
+        if not isinstance(e, InjectedFault):
+            raise
+        _chaos_miss("plan_broadcast", e)
+        return entry, source, extra
+    if result.attempts > 1:
+        extra["attempts"] = result.attempts
+        extra["backoff_ms"] = result.backoff_ms
+    if entry is not None or result.blob is None:
+        if result.blob is None:
+            from .resilience.fallback import record_resilience_event
+
+            record_resilience_event(
+                "exhausted", "plan_broadcast",
+                action_detail="cold_solve", attempts=result.attempts,
+            )
+        return entry, source, extra
+    try:
+        candidate = plan_io.decode_plan(result.blob, env_sig=env_sig)
+    except plan_io.PlanDecodeError as e:
+        _reject_loaded_entry("plan_broadcast", type(e).__name__)
+        return entry, source, extra
+    if not _verify_loaded_entry(candidate, key):
+        _reject_loaded_entry("plan_broadcast", plan_store.MISS_VERIFY)
+        return entry, source, extra
+    if store is not None:  # write-through: future processes warm-start
+        store.write(digest, result.blob)
+    return candidate, "broadcast", extra
+
+
+def _persist_entry(
+    sig: tuple,
+    key: DistAttnRuntimeKey,
+    entry: dict,
+    store: plan_store.PlanStore | None = ...,
+) -> None:
+    """Write-through after a cold solve: serialize once, land in the disk
+    store, and (as broadcast leader) publish to the other hosts. Never
+    costs the step — every failure is a recorded degradation except the
+    chaos contract's typed raise."""
+    if store is ...:
+        store = plan_store.get_store()
+    transport = plan_broadcast.get_transport()
+    publish = transport is not None and plan_broadcast.is_leader()
+    if store is None and not publish:
+        return
+    wire_entry = {
+        k: v for k, v in entry.items() if k in ("dispatch", "static", "dynamic")
+    }
+    try:
+        blob = plan_io.encode_plan(wire_entry, env_sig=key.env_snapshot)
+    except Exception as e:
+        from .resilience.errors import InjectedFault
+
+        if not isinstance(e, InjectedFault):
+            raise
+        _chaos_miss("plan_serialize", e)
+        return
+    digest = plan_io.plan_signature_digest(sig)
+    if store is not None:
+        store.write(digest, blob)
+    if publish:
+        try:
+            plan_broadcast.exchange_plan(digest, blob)
+        except Exception as e:
+            from .resilience.errors import InjectedFault
+
+            if not isinstance(e, InjectedFault):
+                raise
+            _chaos_miss("plan_broadcast", e)
+
+
 class DistAttnRuntimeMgr:
     """Owns metas + runtime for one key (ref :164-483)."""
 
@@ -193,6 +378,21 @@ class DistAttnRuntimeMgr:
         cache_on = env_general.is_plan_cache_enable()
         sig = _plan_signature(key) if cache_on else None
         entry = _PLAN_CACHE.lookup(sig) if cache_on else None
+        # where this manager's solved plan came from:
+        # memory | disk | broadcast | cold (stamped on plan_solve telemetry)
+        self.plan_source = "memory" if entry is not None else "cold"
+        self._plan_meta: dict = {}
+        if cache_on:
+            fetched, src, extra = _control_plane_lookup(
+                sig, key, entry, self.plan_source
+            )
+            if entry is None and fetched is not None:
+                entry = fetched
+                self.plan_source = src
+                self._plan_meta = extra
+                # promote into the memory tier: the next resolution of this
+                # signature is a plain LRU hit
+                _PLAN_CACHE.store(sig, entry)
 
         if entry is not None:
             # solved-plan cache hit: the whole solver pipeline (dispatch +
@@ -246,7 +446,8 @@ class DistAttnRuntimeMgr:
                 if telemetry.enabled():
                     telemetry.record_event(
                         "plan_solve", planner="dynamic", event="cache_hit",
-                        incremental=False, wall_ms=0.0, rows_resolved=0,
+                        source=self.plan_source, incremental=False,
+                        wall_ms=0.0, rows_resolved=0, **self._plan_meta,
                     )
                 built_dynamic = True
             else:
@@ -277,18 +478,20 @@ class DistAttnRuntimeMgr:
                 else:
                     built_dynamic = True
                     if cache_on:
-                        _PLAN_CACHE.store(sig, {
+                        new_entry = {
                             "dispatch": (
                                 self.dispatch_meta_q,
                                 self.dispatch_meta_kv,
                                 self.bucket,
                             ),
                             "dynamic": self.dynamic_plan,
-                        })
+                        }
+                        _PLAN_CACHE.store(sig, new_entry)
                         _PLAN_CACHE.set_dyn_state(
                             _mask_family(sig),
                             self.dynamic_plan.solver_state,
                         )
+                        _persist_entry(sig, key, new_entry)
             if built_dynamic:
                 self.comm_meta = self.calc_meta = None
                 self.runtime = DynamicDistAttnRuntime(
@@ -320,7 +523,8 @@ class DistAttnRuntimeMgr:
             if telemetry.enabled():
                 telemetry.record_event(
                     "plan_solve", planner="static", event="cache_hit",
-                    incremental=False, wall_ms=0.0, rows_resolved=0,
+                    source=self.plan_source, incremental=False,
+                    wall_ms=0.0, rows_resolved=0, **self._plan_meta,
                 )
         else:
             self.comm_meta, self.calc_meta = make_attn_meta_from_dispatch_meta(
@@ -335,6 +539,7 @@ class DistAttnRuntimeMgr:
                 )
                 new_entry["static"] = (self.comm_meta, self.calc_meta)
                 _PLAN_CACHE.store(sig, new_entry)
+                _persist_entry(sig, key, new_entry)
         overlap_cfg = key.config.overlap_config
         self.runtime = DistAttnRuntime(
             comm_meta=self.comm_meta,

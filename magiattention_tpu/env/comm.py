@@ -51,6 +51,59 @@ def split_alignment() -> int:
     return _get_int("MAGI_ATTENTION_SPLIT_ALIGNMENT", 128)
 
 
+def is_plan_broadcast_enable() -> bool:
+    """Solve-once-broadcast tier of the plan control plane
+    (meta/plan_broadcast.py): the leader host solves, every other host
+    receives the serialized plan instead of cold-solving. Byte-exact reuse
+    (every received plan is checksum- and R1-R5-verified), so — like
+    MAGI_ATTENTION_PLAN_CACHE / PLAN_STORE — not a runtime-cache-key flag."""
+    return _get_bool("MAGI_ATTENTION_PLAN_BROADCAST")
+
+
+def plan_broadcast_transport() -> str:
+    """Broadcast transport: ``auto`` (multihost when jax.process_count()>1,
+    else the filesystem transport when a dir is set) | ``multihost``
+    (jax.experimental.multihost_utils) | ``file`` (shared-directory
+    publish/poll — single-host tests, or meshes without a jax distributed
+    client)."""
+    from .general import _get_str
+
+    return _get_str("MAGI_ATTENTION_PLAN_BROADCAST_TRANSPORT", "auto").lower()
+
+
+def plan_broadcast_dir() -> str:
+    """Shared directory for the ``file`` broadcast transport."""
+    from .general import _get_str
+
+    return _get_str("MAGI_ATTENTION_PLAN_BROADCAST_DIR", "plan_broadcast")
+
+
+def plan_broadcast_role() -> str:
+    """Role override for the broadcast tier: ``auto`` (leader iff
+    jax.process_index()==0) | ``leader`` | ``follower``. The override
+    exists for tests and for meshes where host 0 is not the solver."""
+    from .general import _get_str
+
+    return _get_str("MAGI_ATTENTION_PLAN_BROADCAST_ROLE", "auto").lower()
+
+
+def plan_broadcast_retries() -> int:
+    """Receive attempts after the first before the broadcast tier gives up
+    and degrades to a local cold solve."""
+    return _get_int("MAGI_ATTENTION_PLAN_BROADCAST_RETRIES", 3)
+
+
+def plan_broadcast_backoff_ms() -> int:
+    """Initial retry backoff (doubles per attempt, capped by the deadline)."""
+    return _get_int("MAGI_ATTENTION_PLAN_BROADCAST_BACKOFF_MS", 50)
+
+
+def plan_broadcast_deadline_ms() -> int:
+    """Hard wall-clock budget for one broadcast receive, all retries
+    included; exhaustion is a recorded degradation, never a raise."""
+    return _get_int("MAGI_ATTENTION_PLAN_BROADCAST_DEADLINE_MS", 5000)
+
+
 def is_ragged_grpcoll_enable() -> bool:
     """Use ``jax.lax.ragged_all_to_all`` for GroupCast — true per-pair split
     sizes, zero padding on the wire (the TPU counterpart of the reference's

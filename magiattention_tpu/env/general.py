@@ -95,6 +95,20 @@ def plan_cache_size() -> int:
     return _get_int("MAGI_ATTENTION_PLAN_CACHE_SIZE", 100)
 
 
+def is_plan_store_enable() -> bool:
+    """On-disk tier of the solved-plan cache (meta/plan_store.py): plans
+    persist across processes and restarts in a shared directory, keyed by
+    the mask signature digest. Like MAGI_ATTENTION_PLAN_CACHE, reuse is
+    byte-exact (every load is checksum-verified and re-verified by R1-R5),
+    so this is not a runtime-cache-key flag."""
+    return _get_bool("MAGI_ATTENTION_PLAN_STORE")
+
+
+def plan_store_dir() -> str:
+    """Directory of the on-disk plan store (shared across processes)."""
+    return _get_str("MAGI_ATTENTION_PLAN_STORE_DIR", "plan_store")
+
+
 def is_incremental_solve_enable() -> bool:
     """Dynamic-solver incremental re-solve: diff the mask's rectangles
     against the previous solve's state and re-run the assignment algorithm

@@ -1,0 +1,192 @@
+"""Solve-once-broadcast transport — the wire tier of the plan control
+plane (docs/plan_control_plane.md).
+
+One host (the leader — ``jax.process_index() == 0`` unless overridden by
+``MAGI_ATTENTION_PLAN_BROADCAST_ROLE``) solves each plan; every other host
+receives the serialized blob instead of cold-solving. Two transports behind
+one ``exchange`` interface:
+
+- :class:`MultihostTransport` — ``jax.experimental.multihost_utils
+  .broadcast_one_to_all`` on real multi-process meshes. Collective by
+  nature: every process calls ``exchange`` at the same program point (the
+  manager does, once per plan resolution), the leader contributes its blob,
+  everyone receives it. Requires an initialized jax distributed client.
+- :class:`FileTransport` — shared-directory publish/poll
+  (``MAGI_ATTENTION_PLAN_BROADCAST_DIR``). The leader atomically publishes
+  ``bcast-<digest>.bin`` (same tmp+rename idiom as plan_store); followers
+  poll with bounded retry + exponential backoff under a hard deadline
+  (``..._RETRIES`` / ``..._BACKOFF_MS`` / ``..._DEADLINE_MS``). This is the
+  single-host test transport and the fallback for fleets without a jax
+  distributed client.
+
+Degradation contract: a follower that exhausts its retries (or any
+transport error) gets ``blob=None`` back — the manager records a
+``resilience`` event and cold-solves locally; nothing is raised. The
+``plan_broadcast`` injection site arms once per ``exchange`` and follows
+the standard recover-or-typed-raise chaos contract in the manager layer.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+from .. import telemetry
+from ..env import comm as env_comm
+
+
+@dataclass
+class BroadcastResult:
+    """Outcome of one exchange: the blob (None = degraded to cold solve)
+    plus the retry/backoff telemetry counters."""
+
+    blob: bytes | None
+    attempts: int = 1
+    backoff_ms: float = 0.0
+
+
+def is_leader() -> bool:
+    """Leader solves and publishes; followers receive. ``auto`` resolves to
+    jax.process_index()==0 (single-process runs are always the leader)."""
+    role = env_comm.plan_broadcast_role()
+    if role == "leader":
+        return True
+    if role == "follower":
+        return False
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class FileTransport:
+    """Shared-directory publish/poll transport."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+
+    def path_for(self, digest: str) -> str:
+        return os.path.join(self.directory, f"bcast-{digest}.bin")
+
+    def exchange(self, digest: str, blob: bytes | None) -> BroadcastResult:
+        if blob is not None:  # leader: publish, keep own blob
+            self._publish(digest, blob)
+            return BroadcastResult(blob)
+        return self._receive(digest)
+
+    def _publish(self, digest: str, blob: bytes) -> None:
+        path = self.path_for(digest)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            telemetry.inc("plan_broadcast.publish_error")
+
+    def _receive(self, digest: str) -> BroadcastResult:
+        path = self.path_for(digest)
+        retries = max(env_comm.plan_broadcast_retries(), 0)
+        backoff_s = max(env_comm.plan_broadcast_backoff_ms(), 1) / 1000.0
+        deadline = time.monotonic() + (
+            max(env_comm.plan_broadcast_deadline_ms(), 0) / 1000.0
+        )
+        backoff_total = 0.0
+        for attempt in range(retries + 1):
+            try:
+                with open(path, "rb") as f:
+                    return BroadcastResult(
+                        f.read(), attempts=attempt + 1,
+                        backoff_ms=backoff_total * 1000.0,
+                    )
+            except OSError:
+                pass
+            if attempt >= retries:
+                break
+            wait = min(backoff_s * (2**attempt), 2.0)
+            if time.monotonic() + wait > deadline:
+                break
+            telemetry.inc("plan_broadcast.retry")
+            time.sleep(wait)
+            backoff_total += wait
+        return BroadcastResult(
+            None, attempts=attempt + 1, backoff_ms=backoff_total * 1000.0
+        )
+
+
+class MultihostTransport:
+    """broadcast_one_to_all over the jax distributed client. Collective:
+    leader and followers must reach ``exchange`` once per resolution in the
+    same order — the manager guarantees that by exchanging on EVERY plan
+    resolution while this transport is active, hits included."""
+
+    def exchange(self, digest: str, blob: bytes | None) -> BroadcastResult:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        payload = np.frombuffer(blob or b"", dtype=np.uint8)
+        # two collectives: length first (followers size their buffer), then
+        # the padded payload — call counts match on every host by design
+        length = int(
+            multihost_utils.broadcast_one_to_all(
+                np.array([payload.size], dtype=np.int64)
+            )[0]
+        )
+        if length == 0:
+            return BroadcastResult(None)
+        buf = np.zeros(length, dtype=np.uint8)
+        buf[: payload.size] = payload[:length]
+        out = multihost_utils.broadcast_one_to_all(buf)
+        return BroadcastResult(np.asarray(out).tobytes())
+
+
+def get_transport():
+    """The env-configured transport, or None when the broadcast tier is off
+    or not applicable (auto on a single-process run without a broadcast
+    dir). Never raises."""
+    if not env_comm.is_plan_broadcast_enable():
+        return None
+    kind = env_comm.plan_broadcast_transport()
+    if kind == "multihost":
+        return MultihostTransport()
+    if kind == "file":
+        return FileTransport(env_comm.plan_broadcast_dir())
+    # auto: multihost on real multi-process meshes, else the file
+    # transport (its default dir only matters when someone shares it)
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return MultihostTransport()
+    except Exception:
+        pass
+    return FileTransport(env_comm.plan_broadcast_dir())
+
+
+def exchange_plan(digest: str, blob: bytes | None) -> BroadcastResult:
+    """One broadcast exchange; arms the ``plan_broadcast`` chaos site.
+    ``blob is not None`` marks the caller as the publishing leader."""
+    from ..resilience.inject import maybe_inject
+
+    maybe_inject("plan_broadcast")
+    transport = get_transport()
+    if transport is None:
+        return BroadcastResult(blob)
+    result = transport.exchange(digest, blob)
+    if telemetry.enabled():
+        telemetry.record_event(
+            "plan_broadcast",
+            role="leader" if blob is not None else "follower",
+            outcome="ok" if result.blob is not None else "exhausted",
+            attempts=result.attempts,
+            backoff_ms=result.backoff_ms,
+        )
+    return result

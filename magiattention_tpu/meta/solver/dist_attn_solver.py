@@ -399,6 +399,7 @@ class DistAttnSolver:
                 "plan_solve",
                 planner="static",
                 event="solve",
+                source="cold",
                 incremental=False,
                 wall_ms=(time.perf_counter() - t0) * 1e3,
                 rows_total=rows_total,

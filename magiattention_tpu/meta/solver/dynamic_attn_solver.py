@@ -437,6 +437,7 @@ class DynamicAttnSolver:
                 "plan_solve",
                 planner="dynamic",
                 event="solve",
+                source="cold",
                 incremental=incremental,
                 wall_ms=(time.perf_counter() - t0) * 1e3,
                 rows_total=rows_total,

@@ -46,6 +46,9 @@ INJECTION_SITES: tuple[str, ...] = (
     "comm_plan_build",    # static comm-plan build (meta/_make_attn_meta.py)
     "nan_output",         # post-kernel output corruption (resilience/fallback.py)
     "serve_decode",       # paged-decode serving rung (serving/decode.py)
+    "plan_serialize",     # plan wire encoding (meta/plan_io.py)
+    "plan_cache_read",    # on-disk plan store read (meta/plan_store.py)
+    "plan_broadcast",     # cross-host plan broadcast (meta/plan_broadcast.py)
 )
 
 

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Two-process plan-store smoke: warm start + corruption recovery.
+
+The ``make plan-cache-smoke`` gate (folded into ``make test``; ISSUE:
+crash-safe plan control plane). Three child processes share one store
+directory (``MAGI_ATTENTION_PLAN_STORE[_DIR]``):
+
+1. ``--role=populate`` — cold-solves one canonical causal mask and leaves
+   the encoded plan blob(s) behind.
+2. ``--role=warm`` — a FRESH process over the populated store must resolve
+   every plan with ZERO solver runs: its telemetry stream may contain no
+   ``plan_solve`` ``event="solve"`` record and must carry a
+   ``source="disk"`` hit (verified-on-load before first use).
+3. ``--role=corrupted`` — the parent flips one payload byte in every
+   stored blob first; the child must see only typed ``checksum`` misses,
+   silently cold-solve, and heal the store — the parent then checks the
+   rewritten blobs are byte-identical to the pristine pass-1 encodings.
+
+Run directly::
+
+    JAX_PLATFORMS=cpu python scripts/plan_cache_smoke.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# distinctive geometry so the store content is unambiguous to this smoke
+S, CHUNK, CP = 1280, 80, 4
+
+
+def _load_records(tel_dir: str) -> list[dict]:
+    records: list[dict] = []
+    for name in sorted(os.listdir(tel_dir)):
+        if name.endswith(".jsonl"):
+            with open(os.path.join(tel_dir, name)) as f:
+                records += [json.loads(ln) for ln in f if ln.strip()]
+    return records
+
+
+def child(role: str) -> int:
+    """One pass over the shared store; the parent set the env knobs."""
+    import jax
+    import numpy as np
+
+    from magiattention_tpu import telemetry
+    from magiattention_tpu.api import init_dist_attn_runtime_mgr
+
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:CP]), axis_names=("cp",)
+    )
+    mgr = init_dist_attn_runtime_mgr(
+        [[0, S]], [[0, S]], ["causal"], S, S, CHUNK, mesh=mesh
+    )
+    assert mgr.calc_meta is not None
+    telemetry.reset()  # flush the JSONL stream before reading it back
+
+    records = _load_records(os.environ["MAGI_ATTENTION_TELEMETRY_DIR"])
+    solves = [r for r in records if r.get("kind") == "plan_solve"]
+    cold = [r for r in solves if r.get("event") == "solve"]
+    hits = [r for r in solves if r.get("event") == "cache_hit"]
+    if role == "populate":
+        assert cold, "populate pass produced no cold solve"
+    elif role == "warm":
+        # the warm-start proof: ZERO solver runs in this process; every
+        # resolution came off the disk tier (or the memory tier it filled)
+        assert not cold, f"warm start ran the solver: {cold}"
+        assert any(r.get("source") == "disk" for r in hits), (
+            f"no disk-tier resolution in the warm pass: {hits}"
+        )
+        assert all(r.get("source") in ("disk", "memory") for r in hits)
+    elif role == "corrupted":
+        # every stored blob was damaged: typed miss -> silent cold solve
+        assert cold, "corrupted store did not fall back to a cold solve"
+        misses = [
+            r for r in records
+            if r.get("kind") == "plan_store"
+            and r.get("op") == "read" and r.get("outcome") == "miss"
+        ]
+        assert misses, "no plan_store miss recorded over a corrupted store"
+        assert all(r["reason"] == "checksum" for r in misses), misses
+    print(
+        f"plan-cache-smoke child[{role}]: ok "
+        f"({len(cold)} solve(s), {len(hits)} cache hit(s))"
+    )
+    return 0
+
+
+def _spawn(role: str, store_dir: str, tmp: str) -> None:
+    env = os.environ.copy()
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={CP}"
+        ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MAGI_ATTENTION_PLAN_STORE"] = "1"
+    env["MAGI_ATTENTION_PLAN_STORE_DIR"] = store_dir
+    env["MAGI_ATTENTION_TELEMETRY"] = "1"
+    env["MAGI_ATTENTION_TELEMETRY_DIR"] = os.path.join(
+        tmp, f"telemetry-{role}"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--role", role], env=env
+    )
+    if proc.returncode != 0:
+        raise SystemExit(
+            f"plan-cache-smoke child --role={role} failed "
+            f"(exit {proc.returncode})"
+        )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--role", default=None, choices=("populate", "warm", "corrupted"),
+        help="internal: run one child pass instead of orchestrating",
+    )
+    args = ap.parse_args(argv)
+    if args.role:
+        return child(args.role)
+
+    with tempfile.TemporaryDirectory(prefix="plan-cache-smoke-") as tmp:
+        store_dir = os.path.join(tmp, "store")
+        _spawn("populate", store_dir, tmp)
+        blobs: dict[str, bytes] = {}
+        for name in os.listdir(store_dir):
+            if name.startswith("plan-") and name.endswith(".bin"):
+                with open(os.path.join(store_dir, name), "rb") as f:
+                    blobs[name] = f.read()
+        if not blobs:
+            raise SystemExit("populate pass left no plan blobs in the store")
+
+        _spawn("warm", store_dir, tmp)  # ZERO solver calls (child asserts)
+
+        for name, blob in blobs.items():  # flip one payload byte in each
+            mutated = bytearray(blob)
+            mutated[len(mutated) // 2] ^= 0x20
+            with open(os.path.join(store_dir, name), "wb") as f:
+                f.write(bytes(mutated))
+        _spawn("corrupted", store_dir, tmp)
+        for name, blob in blobs.items():
+            with open(os.path.join(store_dir, name), "rb") as f:
+                healed = f.read()
+            if healed != blob:
+                raise SystemExit(
+                    f"store blob {name} was not healed back to the "
+                    "pristine encoding by the recovery cold solve"
+                )
+        print(
+            f"plan-cache-smoke: ok ({len(blobs)} blob(s): populate -> "
+            "warm start with 0 solves -> corrupt -> silent cold-solve heal)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -184,6 +184,21 @@ SECTION_SCHEMAS: dict[str, dict[str, str]] = {
         "wall_ms_mean": "mean solver wall (ms)",
         "two_level_solves": "solves priced with the (dcn, ici) model",
     },
+    "plan_control_plane": {
+        "resolutions": "plan_solve records carrying a source tag",
+        "by_source": "resolutions per tier (cold/memory/disk/broadcast)",
+        "store_reads": "plan_store read records",
+        "store_hits": "store reads that decoded + verified clean",
+        "store_misses": "store reads degraded to a typed miss",
+        "store_miss_reasons": "miss counts per reason",
+        "store_writes": "atomic store publishes that landed",
+        "store_orphans_removed": "crash-orphan .tmp files collected",
+        "broadcasts": "plan_broadcast exchange records",
+        "broadcast_by_role": "exchanges per role (leader/follower)",
+        "broadcast_exhausted": "exchanges that burned every retry",
+        "broadcast_attempts_total": "receive attempts across exchanges",
+        "broadcast_backoff_ms_total": "total backoff slept (ms)",
+    },
     "hier_comm": {
         "plans": "hier_plan records",
         "dcn_rows": "DCN rows after dedup, last plan",
@@ -535,6 +550,55 @@ def aggregate(records: list[dict]) -> dict:
             "wall_ms_mean": sum(walls) / len(walls) if walls else None,
             "two_level_solves": sum(
                 1 for r in solved if r.get("two_level")
+            ),
+        }
+
+    stores = kinds.get("plan_store", [])
+    bcasts = kinds.get("plan_broadcast", [])
+    sourced = [r for r in kinds.get("plan_solve", []) if r.get("source")]
+    if stores or bcasts or sourced:
+        by_source: dict[str, int] = {}
+        for r in sourced:
+            by_source[r["source"]] = by_source.get(r["source"], 0) + 1
+        reads = [r for r in stores if r.get("op") == "read"]
+        writes = [r for r in stores if r.get("op") == "write"]
+        cleanups = [r for r in stores if r.get("op") == "cleanup"]
+        reasons: dict[str, int] = {}
+        for r in reads:
+            if r.get("outcome") == "miss":
+                reason = r.get("reason", "?")
+                reasons[reason] = reasons.get(reason, 0) + 1
+        by_role: dict[str, int] = {}
+        for r in bcasts:
+            role = r.get("role", "?")
+            by_role[role] = by_role.get(role, 0) + 1
+        agg["plan_control_plane"] = {
+            "resolutions": len(sourced),
+            "by_source": dict(sorted(by_source.items())),
+            "store_reads": len(reads),
+            "store_hits": sum(
+                1 for r in reads if r.get("outcome") == "hit"
+            ),
+            "store_misses": sum(
+                1 for r in reads if r.get("outcome") == "miss"
+            ),
+            "store_miss_reasons": dict(sorted(reasons.items())),
+            "store_writes": sum(
+                1 for r in writes if r.get("outcome") == "ok"
+            ),
+            "store_orphans_removed": sum(
+                r.get("removed", 0) for r in cleanups
+            ),
+            "broadcasts": len(bcasts),
+            "broadcast_by_role": dict(sorted(by_role.items())),
+            "broadcast_exhausted": sum(
+                1 for r in bcasts if r.get("outcome") == "exhausted"
+            ),
+            "broadcast_attempts_total": sum(
+                r.get("attempts", 1) for r in bcasts
+            ),
+            "broadcast_backoff_ms_total": sum(
+                r.get("backoff_ms", 0.0) for r in bcasts
             ),
         }
 
@@ -902,6 +966,40 @@ def format_summary(agg: dict) -> str:
         if ps.get("two_level_solves"):
             lines.append(
                 f"  two-level (dcn x ici) solves: {ps['two_level_solves']}"
+            )
+
+    pcp = agg.get("plan_control_plane")
+    if pcp:
+        lines.append("")
+        srcs = " ".join(f"{k}={v}" for k, v in pcp["by_source"].items())
+        lines.append(
+            f"plan control plane: resolutions={pcp['resolutions']}"
+            + (f" [{srcs}]" if srcs else "")
+        )
+        if pcp["store_reads"] or pcp["store_writes"]:
+            miss_s = " ".join(
+                f"{k}={v}" for k, v in pcp["store_miss_reasons"].items()
+            )
+            lines.append(
+                f"  store: reads={pcp['store_reads']} "
+                f"hits={pcp['store_hits']} misses={pcp['store_misses']}"
+                + (f" ({miss_s})" if miss_s else "")
+                + f" writes={pcp['store_writes']}"
+                + (
+                    f" orphans_removed={pcp['store_orphans_removed']}"
+                    if pcp["store_orphans_removed"]
+                    else ""
+                )
+            )
+        if pcp["broadcasts"]:
+            roles = " ".join(
+                f"{k}={v}" for k, v in pcp["broadcast_by_role"].items()
+            )
+            lines.append(
+                f"  broadcast: exchanges={pcp['broadcasts']} [{roles}] "
+                f"exhausted={pcp['broadcast_exhausted']} "
+                f"attempts={pcp['broadcast_attempts_total']} "
+                f"backoff={pcp['broadcast_backoff_ms_total']:.0f} ms"
             )
 
     hc = agg.get("hier_comm")

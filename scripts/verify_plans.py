@@ -37,6 +37,32 @@ from magiattention_tpu.meta import (
 SEQ = 2048
 CHUNK = 128
 
+# plan-wire round-trip rider (ISSUE: crash-safe plan control plane): every
+# solver-built golden plan must survive encode -> decode -> re-encode
+# byte-identically, and the DECODED objects must verify as clean as the
+# originals. Toggled by --skip-roundtrip; counted for the summary line.
+_RT_STATS = {"count": 0}
+_RT_ENV_SIG = ("verify_plans_corpus",)
+
+
+def _roundtrip_errors(label: str, entry: dict, verify_decoded) -> int:
+    from magiattention_tpu.meta import plan_io
+
+    blob = plan_io.encode_plan(entry, env_sig=_RT_ENV_SIG)
+    decoded = plan_io.decode_plan(blob, env_sig=_RT_ENV_SIG)
+    errors = 0
+    if plan_io.encode_plan(decoded, env_sig=_RT_ENV_SIG) != blob:
+        sys.stdout.write(
+            f"[FAIL] {label}/roundtrip: re-encoded bytes differ from the "
+            "original encoding\n"
+        )
+        errors += 1
+    report = verify_decoded(decoded)
+    if report.errors():
+        errors += _report(f"{label}/roundtrip", report, False)
+    _RT_STATS["count"] += 1
+    return errors
+
 
 def canonical_masks() -> dict[str, tuple]:
     """name -> (q_ranges, k_ranges, mask_types); same grid as the golden
@@ -66,7 +92,9 @@ def canonical_masks() -> dict[str, tuple]:
     }
 
 
-def _verify_static(name: str, cp: int, degree: int, verbose: bool) -> int:
+def _verify_static(
+    name: str, cp: int, degree: int, verbose: bool, roundtrip: bool = True
+) -> int:
     qr_l, kr_l, tm = canonical_masks()[name]
     qr = AttnRanges.from_ranges(qr_l)
     kr = AttnRanges.from_ranges(kr_l)
@@ -98,7 +126,28 @@ def _verify_static(name: str, cp: int, degree: int, verbose: bool) -> int:
     skp = -(-max(sk, 1) // bk) * bk
     dq, dkv = resolve_bwd_overrides(bq, bk, sqp, skp)
     check_tiles(report, (bq, bk), sq, sk, dq_blocks=dq, dkv_blocks=dkv)
-    return _report(f"{name}/cp{cp}/ov{degree}", report, verbose)
+    label = f"{name}/cp{cp}/ov{degree}"
+    errors = _report(label, report, verbose)
+    if roundtrip:
+
+        def verify_decoded(d):
+            mq2, mkv2, bucket2 = d["dispatch"]
+            cmm2, calc2 = d["static"]
+            return verify_plan(
+                dispatch_meta=mq2,
+                bucket=bucket2,
+                comm_meta=cmm2,
+                calc_meta=calc2,
+                global_slices=(qr, kr, list(tm), SEQ, SEQ),
+                split_alignment=cfg.grpcoll_config.split_alignment,
+            )
+
+        errors += _roundtrip_errors(
+            label,
+            {"dispatch": (mq, mkv, bucket), "static": (cmm, calc)},
+            verify_decoded,
+        )
+    return errors
 
 
 # two-level (DCN x ICI) golden corpus: mesh shapes x masks; every plan must
@@ -111,7 +160,8 @@ TWO_LEVEL_MASKS: tuple[str, ...] = (
 
 
 def _verify_two_level(
-    name: str, mesh: tuple[int, int], degree: int, verbose: bool
+    name: str, mesh: tuple[int, int], degree: int, verbose: bool,
+    roundtrip: bool = True,
 ) -> int:
     n_outer, n_inner = mesh
     cp = n_outer * n_inner
@@ -142,7 +192,35 @@ def _verify_two_level(
                 "two-level solve produced no hier plan for this stage",
             )
     label = f"{name}/mesh{n_outer}x{n_inner}/ov{degree}"
-    return _report(label, report, verbose)
+    errors = _report(label, report, verbose)
+    if roundtrip:
+        # the decoded two-level plan must keep its solver-attached hier
+        # plans (check_hier_plan runs inside verify_plan on each stage)
+        def verify_decoded(d):
+            mq2, mkv2, bucket2 = d["dispatch"]
+            cmm2, calc2 = d["static"]
+            rep = verify_plan(
+                dispatch_meta=mq2,
+                bucket=bucket2,
+                comm_meta=cmm2,
+                calc_meta=calc2,
+                global_slices=(qr, kr, list(tm), SEQ, SEQ),
+                split_alignment=cfg.grpcoll_config.split_alignment,
+            )
+            for st, s in enumerate(cmm2.kv_stages):
+                if s.hier_plan is None:
+                    rep.add(
+                        "R3", ERROR, f"kv_stage{st}",
+                        "hier plan lost across the wire round-trip",
+                    )
+            return rep
+
+        errors += _roundtrip_errors(
+            label,
+            {"dispatch": (mq, mkv, bucket), "static": (cmm, calc)},
+            verify_decoded,
+        )
+    return errors
 
 
 def ffa_golden_plans() -> list[tuple]:
@@ -242,7 +320,9 @@ def _verify_ffa_plan(row: tuple, verbose: bool) -> int:
     return _report(label, report, verbose)
 
 
-def _verify_dynamic(name: str, cp: int, verbose: bool) -> int:
+def _verify_dynamic(
+    name: str, cp: int, verbose: bool, roundtrip: bool = True
+) -> int:
     from magiattention_tpu.meta._make_attn_meta import make_dynamic_attn_plan
 
     qr_l, kr_l, tm = canonical_masks()[name]
@@ -258,7 +338,18 @@ def _verify_dynamic(name: str, cp: int, verbose: bool) -> int:
     report = verify_dynamic_plan(
         plan, split_alignment=cfg.grpcoll_config.split_alignment
     )
-    return _report(f"{name}/cp{cp}/dynamic", report, verbose)
+    label = f"{name}/cp{cp}/dynamic"
+    errors = _report(label, report, verbose)
+    if roundtrip:
+        errors += _roundtrip_errors(
+            label,
+            {"dispatch": (mq, mkv, _bucket), "dynamic": plan},
+            lambda d: verify_dynamic_plan(
+                d["dynamic"],
+                split_alignment=cfg.grpcoll_config.split_alignment,
+            ),
+        )
+    return errors
 
 
 def _report(label: str, report, verbose: bool) -> int:
@@ -298,6 +389,10 @@ def main(argv: list[str] | None = None) -> int:
         "--skip-ffa", action="store_true",
         help="skip the direct FFA kernel-plan sweep (extents + clamp gate)",
     )
+    ap.add_argument(
+        "--skip-roundtrip", action="store_true",
+        help="skip the plan-wire round-trip rider over solver plans",
+    )
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print warnings")
     args = ap.parse_args(argv)
@@ -308,17 +403,20 @@ def main(argv: list[str] | None = None) -> int:
     cps = [int(x) for x in args.cp_sizes.split(",")]
     degrees = [int(x) for x in args.overlap_degrees.split(",")]
 
+    rt = not args.skip_roundtrip
     total_errors = 0
     n_plans = 0
     for name in masks:
         for cp in cps:
             for degree in degrees:
                 total_errors += _verify_static(
-                    name, cp, degree, args.verbose
+                    name, cp, degree, args.verbose, roundtrip=rt
                 )
                 n_plans += 1
             if not args.skip_dynamic and cp > 1:
-                total_errors += _verify_dynamic(name, cp, args.verbose)
+                total_errors += _verify_dynamic(
+                    name, cp, args.verbose, roundtrip=rt
+                )
                 n_plans += 1
     if not args.skip_two_level:
         for name in TWO_LEVEL_MASKS:
@@ -327,15 +425,20 @@ def main(argv: list[str] | None = None) -> int:
             for mesh in TWO_LEVEL_MESHES:
                 for degree in (1, 2):
                     total_errors += _verify_two_level(
-                        name, mesh, degree, args.verbose
+                        name, mesh, degree, args.verbose, roundtrip=rt
                     )
                     n_plans += 1
     if not args.skip_ffa:
         for row in ffa_golden_plans():
             total_errors += _verify_ffa_plan(row, args.verbose)
             n_plans += 1
+    rt_s = (
+        f", {_RT_STATS['count']} round-tripped byte-identically"
+        if _RT_STATS["count"]
+        else ""
+    )
     sys.stdout.write(
-        f"verified {n_plans} plan(s): "
+        f"verified {n_plans} plan(s){rt_s}: "
         f"{'FAIL' if total_errors else 'all clean'} "
         f"({total_errors} error-severity violation(s))\n"
     )

@@ -1,0 +1,296 @@
+"""Durable plan-store tier (ISSUE: crash-safe plan control plane).
+
+Satellite acceptance for ``meta/plan_io.py`` + ``meta/plan_store.py``:
+round-trips are byte-identical and identity-preserving, EVERY corruption
+class (truncation, bit flip, stale schema, env mismatch) is a typed miss —
+never an exception — a crash-orphaned ``.tmp`` is garbage-collected on the
+next open, and a fresh process over a populated store warm-starts with
+ZERO solver calls while a corrupted store silently self-heals through a
+cold solve."""
+
+import json
+import os
+import struct
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import magiattention_tpu.dist_attn_runtime_mgr as mgr_mod
+from magiattention_tpu import telemetry
+from magiattention_tpu.api import init_dist_attn_runtime_key
+from magiattention_tpu.api.magi_attn_interface import clear_cache
+from magiattention_tpu.dist_attn_runtime_mgr import (
+    _PLAN_CACHE,
+    DistAttnRuntimeMgr,
+)
+from magiattention_tpu.meta import plan_io, plan_store
+from magiattention_tpu.meta.plan_store import (
+    MISS_ABSENT,
+    MISS_CHECKSUM,
+    MISS_ENV_MISMATCH,
+    MISS_SCHEMA,
+    PlanStore,
+)
+
+S, CHUNK = 1152, 72  # distinctive geometry: no other test shares these sigs
+
+STORE_ENV = ("MAGI_ATTENTION_PLAN_STORE", "MAGI_ATTENTION_PLAN_STORE_DIR")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tiers(monkeypatch):
+    for var in STORE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    clear_cache()
+    _PLAN_CACHE.clear()
+    plan_store.reset()
+    telemetry.reset()
+    yield
+    clear_cache()
+    _PLAN_CACHE.clear()
+    plan_store.reset()
+    telemetry.reset()
+
+
+def _mesh(cp=4):
+    return jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:cp]), axis_names=("cp",)
+    )
+
+
+def _key(mesh, s=S):
+    return init_dist_attn_runtime_key(
+        [[0, s]], [[0, s]], ["causal"], s, s, CHUNK, mesh=mesh
+    )
+
+
+def _store_env(monkeypatch, tmp_path, name="store"):
+    d = tmp_path / name
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_STORE", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_STORE_DIR", str(d))
+    plan_store.reset()
+    return d
+
+
+def _count_solvers(monkeypatch):
+    """Call counters over the solver entry points the manager resolves."""
+    calls = {"dispatch": 0, "static": 0}
+    real_dispatch = mgr_mod.make_dispatch_meta_from_qk_ranges
+    real_static = mgr_mod.make_attn_meta_from_dispatch_meta
+
+    def wrap(name, fn):
+        def inner(*a, **kw):
+            calls[name] += 1
+            return fn(*a, **kw)
+
+        return inner
+
+    monkeypatch.setattr(
+        mgr_mod, "make_dispatch_meta_from_qk_ranges",
+        wrap("dispatch", real_dispatch),
+    )
+    monkeypatch.setattr(
+        mgr_mod, "make_attn_meta_from_dispatch_meta",
+        wrap("static", real_static),
+    )
+    return calls
+
+
+def _solved_entry(key):
+    """The plan-cache entry a cold solve produced, filtered to the wire
+    keys exactly as ``_persist_entry`` ships them."""
+    entry = _PLAN_CACHE.lookup(mgr_mod._plan_signature(key))
+    assert entry is not None
+    return {
+        k: v for k, v in entry.items() if k in ("dispatch", "static", "dynamic")
+    }
+
+
+# ---------------------------------------------------------------------------
+# plan_io: canonical round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_is_byte_identical_and_identity_preserving():
+    mesh = _mesh()
+    key = _key(mesh)
+    wire = _solved_entry(key)
+    env_sig = key.env_snapshot
+    blob = plan_io.encode_plan(wire, env_sig=env_sig)
+    out = plan_io.decode_plan(blob, env_sig=env_sig)
+    # re-encoding the decoded objects reproduces the exact bytes
+    assert plan_io.encode_plan(out, env_sig=env_sig) == blob
+    # self-attention shares ONE DispatchMeta: the back-reference survived
+    meta_q, meta_kv, _ = out["dispatch"]
+    assert meta_kv is meta_q
+    # and the decoded plans verify exactly like cold-solved ones
+    assert mgr_mod._verify_loaded_entry(out, key)
+
+
+def test_decode_corruption_matrix_raises_typed():
+    blob = plan_io.encode_plan({"x": 1}, env_sig=("env-a",))
+    hdr = plan_io.HEADER.size
+    # truncation (payload underrun)
+    with pytest.raises(plan_io.PlanChecksumError):
+        plan_io.decode_plan(blob[:-4], env_sig=("env-a",))
+    # truncation into the header itself
+    with pytest.raises(plan_io.PlanDecodeError):
+        plan_io.decode_plan(blob[:10], env_sig=("env-a",))
+    # payload bit flip
+    flipped = bytearray(blob)
+    flipped[hdr] ^= 0x40
+    with pytest.raises(plan_io.PlanChecksumError):
+        plan_io.decode_plan(bytes(flipped), env_sig=("env-a",))
+    # foreign magic
+    with pytest.raises(plan_io.PlanSchemaError):
+        plan_io.decode_plan(b"NOTMAGIC" + blob[8:], env_sig=("env-a",))
+    # stale wire schema version
+    stale = blob[:8] + struct.pack("<I", 99) + blob[12:]
+    with pytest.raises(plan_io.PlanSchemaError):
+        plan_io.decode_plan(stale, env_sig=("env-a",))
+    # env signature mismatch
+    with pytest.raises(plan_io.PlanEnvMismatchError):
+        plan_io.decode_plan(blob, env_sig=("env-b",))
+
+
+# ---------------------------------------------------------------------------
+# plan_store: every corruption class is a typed miss, never an exception
+# ---------------------------------------------------------------------------
+
+
+def test_store_read_miss_matrix(tmp_path):
+    store = PlanStore(str(tmp_path / "s"))
+    env_sig = ("env-a",)
+    blob = plan_io.encode_plan({"x": 1}, env_sig=env_sig)
+    assert store.write("d1", blob)
+    path = store.path_for("d1")
+
+    entry, miss = store.read("d1", env_sig=env_sig)
+    assert entry == {"x": 1} and miss is None
+
+    entry, miss = store.read("nope", env_sig=env_sig)
+    assert entry is None and miss.reason == MISS_ABSENT
+
+    with open(path, "wb") as f:  # truncated file
+        f.write(blob[:-6])
+    entry, miss = store.read("d1", env_sig=env_sig)
+    assert entry is None and miss.reason == MISS_CHECKSUM
+
+    flipped = bytearray(blob)  # single payload bit flip
+    flipped[plan_io.HEADER.size] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(bytes(flipped))
+    entry, miss = store.read("d1", env_sig=env_sig)
+    assert entry is None and miss.reason == MISS_CHECKSUM
+
+    with open(path, "wb") as f:  # stale schema version
+        f.write(blob[:8] + struct.pack("<I", 99) + blob[12:])
+    entry, miss = store.read("d1", env_sig=env_sig)
+    assert entry is None and miss.reason == MISS_SCHEMA
+
+    with open(path, "wb") as f:  # pristine bytes, foreign environment
+        f.write(blob)
+    entry, miss = store.read("d1", env_sig=("env-b",))
+    assert entry is None and miss.reason == MISS_ENV_MISMATCH
+
+
+def test_crash_orphan_tmp_cleanup(tmp_path):
+    d = tmp_path / "s"
+    os.makedirs(d)
+    orphan = d / "plan-dead.bin.tmp-9999-0"
+    orphan.write_bytes(b"half a write")
+    stale = time.time() - plan_store.ORPHAN_TMP_TTL_S - 5
+    os.utime(orphan, (stale, stale))
+    inflight = d / "plan-live.bin.tmp-1234-1"  # a live writer's tmp: young
+    inflight.write_bytes(b"in flight")
+    PlanStore(str(d))
+    assert not orphan.exists()  # crash leftover collected
+    assert inflight.exists()  # concurrent writer untouched
+
+
+# ---------------------------------------------------------------------------
+# manager wiring: warm start, self-healing, verify-on-load
+# ---------------------------------------------------------------------------
+
+
+def test_warm_start_resolves_from_disk_with_zero_solver_calls(
+    monkeypatch, tmp_path
+):
+    store_dir = _store_env(monkeypatch, tmp_path)
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path / "t1"))
+    mesh = _mesh()
+    key = _key(mesh)  # cold solve; write-through populates the store
+    assert len(list(store_dir.glob("plan-*.bin"))) == 1
+    # simulate a fresh process: empty memory tiers, populated disk
+    clear_cache()
+    _PLAN_CACHE.clear()
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path / "t2"))
+    calls = _count_solvers(monkeypatch)
+    try:
+        mgr = DistAttnRuntimeMgr(key, mesh)
+    finally:
+        telemetry.reset()  # flush before reading the stream back
+    assert calls == {"dispatch": 0, "static": 0}
+    assert mgr.plan_source == "disk"
+    records = []
+    for fp in sorted((tmp_path / "t2").glob("*.jsonl")):
+        with open(fp) as f:
+            records += [json.loads(ln) for ln in f if ln.strip()]
+    solves = [r for r in records if r.get("kind") == "plan_solve"]
+    assert solves and all(r["event"] == "cache_hit" for r in solves)
+    assert all(r["source"] == "disk" for r in solves)
+    hits = [r for r in records if r.get("kind") == "plan_store"]
+    assert any(r["op"] == "read" and r["outcome"] == "hit" for r in hits)
+
+
+def test_corrupted_store_cold_solves_and_self_heals(monkeypatch, tmp_path):
+    store_dir = _store_env(monkeypatch, tmp_path)
+    mesh = _mesh()
+    key = _key(mesh)
+    (path,) = store_dir.glob("plan-*.bin")
+    pristine = path.read_bytes()
+    mutated = bytearray(pristine)
+    mutated[len(mutated) // 2] ^= 0x10  # one flipped payload bit
+    path.write_bytes(bytes(mutated))
+    clear_cache()
+    _PLAN_CACHE.clear()
+    calls = _count_solvers(monkeypatch)
+    mgr = DistAttnRuntimeMgr(key, mesh)
+    # the flip was a miss, not an error: full silent cold solve
+    assert mgr.plan_source == "cold"
+    assert calls == {"dispatch": 1, "static": 1}
+    # and the write-through healed the store back to the exact bytes
+    assert path.read_bytes() == pristine
+
+
+def test_unverifiable_entry_is_rejected_to_cold_solve(monkeypatch, tmp_path):
+    _store_env(monkeypatch, tmp_path)
+    mesh = _mesh()
+    key = _key(mesh)
+    clear_cache()
+    _PLAN_CACHE.clear()
+    # decodes fine, but R1-R5 says no: must be treated as a miss
+    monkeypatch.setattr(
+        mgr_mod, "_verify_loaded_entry", lambda entry, key: False
+    )
+    calls = _count_solvers(monkeypatch)
+    mgr = DistAttnRuntimeMgr(key, mesh)
+    assert mgr.plan_source == "cold"
+    assert calls == {"dispatch": 1, "static": 1}
+
+
+def test_verifier_catches_semantic_corruption():
+    """A decoded entry whose ranges were tampered with fails
+    ``_verify_loaded_entry`` even though every checksum passes."""
+    mesh = _mesh()
+    key = _key(mesh)
+    wire = _solved_entry(key)
+    blob = plan_io.encode_plan(wire, env_sig=key.env_snapshot)
+    entry = plan_io.decode_plan(blob, env_sig=key.env_snapshot)
+    assert mgr_mod._verify_loaded_entry(entry, key)
+    bucket = entry["dispatch"][2]
+    bucket.q_chunks.pop()  # drop a chunk: coverage invariant breaks
+    assert not mgr_mod._verify_loaded_entry(entry, key)

@@ -1,0 +1,230 @@
+"""Chaos matrix for the plan control plane (docs/plan_control_plane.md):
+the ``plan_serialize``, ``plan_cache_read`` and ``plan_broadcast`` sites
+each either RECOVER to a bit-identical cold solve (MAGI_ATTENTION_FALLBACK=1,
+recorded as a typed resilience event) or RAISE their typed InjectedFault —
+a corrupted/unreachable tier may never change results or crash a step."""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.meta import plan_store
+from magiattention_tpu.resilience.errors import InjectedFault
+
+from tests.test_resilience.conftest import make_mgr, run_step
+
+pytestmark = pytest.mark.chaos
+
+PLAN_ENV = (
+    "MAGI_ATTENTION_PLAN_STORE",
+    "MAGI_ATTENTION_PLAN_STORE_DIR",
+    "MAGI_ATTENTION_PLAN_BROADCAST",
+    "MAGI_ATTENTION_PLAN_BROADCAST_TRANSPORT",
+    "MAGI_ATTENTION_PLAN_BROADCAST_DIR",
+    "MAGI_ATTENTION_PLAN_BROADCAST_ROLE",
+    "MAGI_ATTENTION_PLAN_BROADCAST_RETRIES",
+    "MAGI_ATTENTION_PLAN_BROADCAST_BACKOFF_MS",
+    "MAGI_ATTENTION_PLAN_BROADCAST_DEADLINE_MS",
+)
+
+
+def _clear_warm_tiers():
+    """Drop every in-process warm tier: the runtime-manager LRU (same key
+    -> cached manager -> no solve at all), the plan memory LRU, and the
+    store-handle cache. A leaked warm tier would mask a site never firing."""
+    from magiattention_tpu.api.magi_attn_interface import clear_cache
+    from magiattention_tpu.dist_attn_runtime_mgr import _PLAN_CACHE
+
+    clear_cache()
+    _PLAN_CACHE.clear()
+    plan_store.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_control_plane(monkeypatch):
+    for var in PLAN_ENV:
+        monkeypatch.delenv(var, raising=False)
+    _clear_warm_tiers()
+    yield
+    _clear_warm_tiers()
+
+
+def _enable_store(monkeypatch, tmp_path, name="store"):
+    d = tmp_path / name
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_STORE", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_STORE_DIR", str(d))
+    plan_store.reset()
+    return d
+
+
+def _enable_broadcast(
+    monkeypatch, tmp_path, role, name="bcast", retries=1, backoff_ms=1,
+    deadline_ms=250,
+):
+    d = tmp_path / name
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_BROADCAST", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_BROADCAST_TRANSPORT", "file")
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_BROADCAST_DIR", str(d))
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_BROADCAST_ROLE", role)
+    monkeypatch.setenv("MAGI_ATTENTION_PLAN_BROADCAST_RETRIES", str(retries))
+    monkeypatch.setenv(
+        "MAGI_ATTENTION_PLAN_BROADCAST_BACKOFF_MS", str(backoff_ms)
+    )
+    monkeypatch.setenv(
+        "MAGI_ATTENTION_PLAN_BROADCAST_DEADLINE_MS", str(deadline_ms)
+    )
+    return d
+
+
+def _enable_telemetry(monkeypatch, tmp_path):
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path / "tel"))
+
+
+# ---------------------------------------------------------------------------
+# site: plan_serialize — plan wire encoding (meta/plan_io.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanSerialize:
+    def test_recovers_and_skips_persist(self, monkeypatch, tmp_path):
+        base_out, _ = run_step(make_mgr())
+        store_dir = _enable_store(monkeypatch, tmp_path)
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_serialize")
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        out, _ = run_step(make_mgr())
+        # the step is untouched: persisting is write-through, never load-bearing
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base_out))
+        # ... but nothing unserializable landed in the store
+        assert not list(store_dir.glob("plan-*.bin"))
+
+    def test_raises_typed_without_fallback(self, monkeypatch, tmp_path):
+        _enable_store(monkeypatch, tmp_path)
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_serialize")
+        with pytest.raises(InjectedFault, match="plan_serialize"):
+            make_mgr()
+
+
+# ---------------------------------------------------------------------------
+# site: plan_cache_read — on-disk plan store read (meta/plan_store.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanCacheRead:
+    def test_recovers_via_cold_solve(self, monkeypatch, tmp_path):
+        from magiattention_tpu.dist_attn_runtime_mgr import _PLAN_CACHE
+
+        store_dir = _enable_store(monkeypatch, tmp_path)
+        base_out, _ = run_step(make_mgr())  # populates the store
+        assert list(store_dir.glob("plan-*.bin"))
+        _PLAN_CACHE.clear()  # force the disk tier on the next build
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_cache_read")
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        out, _ = run_step(make_mgr())
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base_out))
+
+    def test_raises_typed_without_fallback(self, monkeypatch, tmp_path):
+        _enable_store(monkeypatch, tmp_path)
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_cache_read")
+        with pytest.raises(InjectedFault, match="plan_cache_read"):
+            make_mgr()
+
+
+# ---------------------------------------------------------------------------
+# site: plan_broadcast — cross-host plan exchange (meta/plan_broadcast.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanBroadcast:
+    def test_follower_recovers_via_cold_solve(self, monkeypatch, tmp_path):
+        base_out, _ = run_step(make_mgr())
+        _enable_broadcast(monkeypatch, tmp_path, role="follower")
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_broadcast")
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        out, _ = run_step(make_mgr())
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base_out))
+
+    def test_leader_recovers_and_skips_publish(self, monkeypatch, tmp_path):
+        base_out, _ = run_step(make_mgr())
+        bdir = _enable_broadcast(monkeypatch, tmp_path, role="leader")
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_broadcast")
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        out, _ = run_step(make_mgr())
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base_out))
+        assert not list(bdir.glob("bcast-*.bin"))  # the publish was abandoned
+
+    def test_raises_typed_without_fallback(self, monkeypatch, tmp_path):
+        _enable_broadcast(monkeypatch, tmp_path, role="follower")
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_broadcast")
+        with pytest.raises(InjectedFault, match="plan_broadcast"):
+            make_mgr()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder: every control-plane site down at p=1.0 at once
+# ---------------------------------------------------------------------------
+
+
+class TestDegradationLadder:
+    def test_all_sites_down_still_serves_bitwise_correct_plans(
+        self, monkeypatch, tmp_path
+    ):
+        base_out, _ = run_step(make_mgr())
+        _enable_telemetry(monkeypatch, tmp_path)
+        store_dir = _enable_store(monkeypatch, tmp_path)
+        _enable_broadcast(monkeypatch, tmp_path, role="follower")
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT",
+            "plan_cache_read:p=1.0,plan_broadcast:p=1.0,plan_serialize:p=1.0",
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        out, _ = run_step(make_mgr())
+        # every tier below memory is dead, yet the answer is the answer
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base_out))
+        # each degraded hop was recorded, not swallowed
+        counters = telemetry.get_collector().counters
+        assert counters.get("resilience.injected", 0) >= 3
+        assert counters.get("resilience.fallback", 0) >= 3
+        # and the dead serializer kept the store empty rather than poisoned
+        assert not list(store_dir.glob("plan-*.bin"))
+
+
+# ---------------------------------------------------------------------------
+# broadcast exhaustion: retries burn out -> local cold solve, bit-identical
+# to the plan the leader published
+# ---------------------------------------------------------------------------
+
+
+class TestBroadcastExhaustion:
+    def test_exhausted_follower_solves_the_leader_plan_bitwise(
+        self, monkeypatch, tmp_path
+    ):
+        _enable_telemetry(monkeypatch, tmp_path)
+        # leader pass: cold solve + publish
+        pub_dir = _enable_broadcast(
+            monkeypatch, tmp_path, role="leader", name="bcast-pub"
+        )
+        out_leader, _ = run_step(make_mgr())
+        published = {p.name: p.read_bytes() for p in pub_dir.glob("bcast-*.bin")}
+        assert published
+        # follower pass against an EMPTY broadcast dir: every receive
+        # retries, backs off, exhausts, and degrades to a local cold solve
+        _clear_warm_tiers()
+        _enable_broadcast(
+            monkeypatch, tmp_path, role="follower", name="bcast-empty"
+        )
+        store_dir = _enable_store(monkeypatch, tmp_path, name="store-follower")
+        out_follower, _ = run_step(make_mgr())
+        np.testing.assert_array_equal(
+            np.asarray(out_follower), np.asarray(out_leader)
+        )
+        counters = telemetry.get_collector().counters
+        assert counters.get("resilience.exhausted", 0) >= 1
+        assert counters.get("plan_broadcast.retry", 0) >= 1
+        # the degraded local solve wrote the byte-identical blob the
+        # broadcast would have delivered — same digest, same payload
+        stored = list(store_dir.glob("plan-*.bin"))
+        assert stored
+        for path in stored:
+            digest = path.name[len("plan-") : -len(".bin")]
+            assert path.read_bytes() == published[f"bcast-{digest}.bin"]

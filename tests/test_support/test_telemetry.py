@@ -236,3 +236,66 @@ def test_runtime_dict_stats(monkeypatch):
         "hits": 1, "misses": 4, "evictions": 2, "size": 2, "maxsize": 2,
     }
     assert d.get("b") is None and d.get("c") is not None
+
+
+def test_report_plan_control_plane_round_trip(tmp_path, monkeypatch, capsys):
+    """Synthetic control-plane records (ISSUE: crash-safe plan control
+    plane) must aggregate into the report's plan_control_plane section and
+    survive the JSONL round trip."""
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+    monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path))
+    telemetry.record_event(
+        "plan_solve", planner="static", event="solve", source="cold",
+        incremental=False, wall_ms=1.5, rows_resolved=4, rows_total=4,
+    )
+    telemetry.record_event(
+        "plan_solve", planner="static", event="cache_hit", source="disk",
+        incremental=False, wall_ms=0.0, rows_resolved=0,
+    )
+    telemetry.record_event(
+        "plan_solve", planner="dynamic", event="cache_hit",
+        source="broadcast", incremental=False, wall_ms=0.0,
+        rows_resolved=0, attempts=2, backoff_ms=3.0,
+    )
+    telemetry.record_event(
+        "plan_store", op="read", outcome="hit", bytes=512
+    )
+    telemetry.record_event(
+        "plan_store", op="read", outcome="miss", reason="checksum",
+        detail="payload sha mismatch",
+    )
+    telemetry.record_event("plan_store", op="write", outcome="ok", bytes=512)
+    telemetry.record_event("plan_store", op="cleanup", outcome="ok", removed=1)
+    telemetry.record_event(
+        "plan_broadcast", role="leader", outcome="ok", attempts=1,
+        backoff_ms=0.0,
+    )
+    telemetry.record_event(
+        "plan_broadcast", role="follower", outcome="exhausted", attempts=3,
+        backoff_ms=12.0,
+    )
+    telemetry.reset()  # flush/close before the reader opens the file
+
+    mod = load_script(REPORT, "telemetry_report")
+    assert "plan_control_plane" in mod.SECTION_SCHEMAS
+    records = mod.load_records([str(tmp_path)])
+    agg = mod.aggregate(records)
+    pcp = agg["plan_control_plane"]
+    assert pcp["resolutions"] == 3
+    assert pcp["by_source"] == {"broadcast": 1, "cold": 1, "disk": 1}
+    assert pcp["store_reads"] == 2
+    assert pcp["store_hits"] == 1 and pcp["store_misses"] == 1
+    assert pcp["store_miss_reasons"] == {"checksum": 1}
+    assert pcp["store_writes"] == 1
+    assert pcp["store_orphans_removed"] == 1
+    assert pcp["broadcasts"] == 2
+    assert pcp["broadcast_by_role"] == {"follower": 1, "leader": 1}
+    assert pcp["broadcast_exhausted"] == 1
+    assert pcp["broadcast_attempts_total"] == 4
+    assert pcp["broadcast_backoff_ms_total"] == 12.0
+    text = mod.format_summary(agg)
+    for token in ("plan control plane", "store:", "broadcast:"):
+        assert token in text
+
+    assert mod.main([str(tmp_path)]) == 0
+    assert "plan control plane" in capsys.readouterr().out
