@@ -244,13 +244,18 @@ def _reject_loaded_entry(site: str, reason: str) -> None:
 
 def _control_plane_lookup(
     sig: tuple, key: DistAttnRuntimeKey, entry: dict | None, source: str
-) -> tuple[dict | None, str, dict]:
+) -> tuple[dict | None, str, dict, bool]:
     """Run the disk + broadcast tiers for one plan resolution.
 
     ``entry``/``source`` are the memory tier's result; returns the
-    (possibly upgraded) ``(entry, source, telemetry_extra)``. Loaded
-    entries are verified here; a broadcast-received entry is written
-    through to the disk store so later processes warm-start locally."""
+    (possibly upgraded) ``(entry, source, telemetry_extra, exchanged)``,
+    where ``exchanged`` records that this resolution's one collective
+    broadcast exchange already happened (so ``_persist_entry`` must not
+    publish again — hosts pair ``broadcast_one_to_all`` calls one-to-one,
+    and a second leader-side exchange would desync every later pairing).
+    Loaded entries are verified here; a broadcast-received entry is
+    written through to the disk store so later processes warm-start
+    locally."""
     env_sig = key.env_snapshot
     digest: str | None = None
     extra: dict = {}
@@ -275,20 +280,33 @@ def _control_plane_lookup(
 
     transport = plan_broadcast.get_transport()
     if transport is None:
-        return entry, source, extra
+        return entry, source, extra, False
     leader = plan_broadcast.is_leader()
     multihost = isinstance(transport, plan_broadcast.MultihostTransport)
     if digest is None:
         digest = plan_io.plan_signature_digest(sig)
     if leader:
-        # the multihost transport is collective — the leader must exchange
-        # on EVERY resolution (hits included) so follower receive counts
-        # align; a cold leader publishes later, in _persist_entry
+        exchanged = False
         if multihost and entry is not None:
+            # the multihost transport is collective — the leader must
+            # exchange on EVERY resolution (hits included) so follower
+            # receive counts align. This publish IS the resolution's one
+            # exchange: the returned flag makes any later _persist_entry
+            # (e.g. a dynamic re-solve over a static-fallback hit) skip
+            # its publish instead of exchanging a second time.
+            exchanged = _persist_entry(sig, key, entry, store=None)
+        elif (
+            entry is not None
+            and isinstance(transport, plan_broadcast.FileTransport)
+            and not transport.published_ok(digest, env_sig)
+        ):
+            # warm leader, file transport: the published blob is missing
+            # or corrupt (e.g. a crash raced the publish) — heal it so
+            # followers stop burning the full retry path on this digest
             _persist_entry(sig, key, entry, store=None)
-        return entry, source, extra
+        return entry, source, extra, exchanged
     if entry is not None and not multihost:
-        return entry, source, extra
+        return entry, source, extra, False
     try:
         result = plan_broadcast.exchange_plan(digest, None)
     except Exception as e:
@@ -297,7 +315,7 @@ def _control_plane_lookup(
         if not isinstance(e, InjectedFault):
             raise
         _chaos_miss("plan_broadcast", e)
-        return entry, source, extra
+        return entry, source, extra, False
     if result.attempts > 1:
         extra["attempts"] = result.attempts
         extra["backoff_ms"] = result.backoff_ms
@@ -309,18 +327,50 @@ def _control_plane_lookup(
                 "exhausted", "plan_broadcast",
                 action_detail="cold_solve", attempts=result.attempts,
             )
-        return entry, source, extra
+        return entry, source, extra, False
     try:
-        candidate = plan_io.decode_plan(result.blob, env_sig=env_sig)
+        candidate = plan_io.decode_plan(
+            result.blob, env_sig=env_sig, expect_digest=digest
+        )
     except plan_io.PlanDecodeError as e:
         _reject_loaded_entry("plan_broadcast", type(e).__name__)
-        return entry, source, extra
+        return entry, source, extra, False
     if not _verify_loaded_entry(candidate, key):
         _reject_loaded_entry("plan_broadcast", plan_store.MISS_VERIFY)
-        return entry, source, extra
+        return entry, source, extra, False
     if store is not None:  # write-through: future processes warm-start
         store.write(digest, result.blob)
-    return candidate, "broadcast", extra
+    return candidate, "broadcast", extra, False
+
+
+def _persist_failure(
+    site: str, err: Exception, collective_transport, digest: str
+) -> None:
+    """Failure tail of ``_persist_entry``: an InjectedFault follows the
+    chaos contract (recover under fallback, typed raise without), any
+    genuine error is a recorded degradation — persisting is write-through
+    and must never cost the step. Either way, when a collective transport
+    is mid-resolution (followers already blocked in their receive), the
+    exchange is completed with a zero-length blob so their collective call
+    pairs off and they degrade to a local cold solve instead of hanging."""
+    from .resilience.errors import InjectedFault
+
+    try:
+        if isinstance(err, InjectedFault):
+            _chaos_miss(site, err)
+        else:
+            from .resilience.fallback import record_resilience_event
+
+            record_resilience_event(
+                "fallback", site, action_detail="skip_persist",
+                error=type(err).__name__,
+            )
+    finally:
+        if collective_transport is not None:
+            try:
+                collective_transport.exchange(digest, b"")
+            except Exception:
+                pass
 
 
 def _persist_entry(
@@ -328,41 +378,52 @@ def _persist_entry(
     key: DistAttnRuntimeKey,
     entry: dict,
     store: plan_store.PlanStore | None = ...,
-) -> None:
-    """Write-through after a cold solve: serialize once, land in the disk
-    store, and (as broadcast leader) publish to the other hosts. Never
-    costs the step — every failure is a recorded degradation except the
-    chaos contract's typed raise."""
+    exchanged: bool = False,
+) -> bool:
+    """Write-through after a solve: serialize once, land in the disk
+    store, and (as broadcast leader) publish to the other hosts — unless
+    ``exchanged`` says this resolution's one collective exchange already
+    happened. Never costs the step — every failure is a recorded
+    degradation except the chaos contract's typed raise, and on a
+    collective transport even the failure paths complete the exchange
+    (zero-length blob) so followers never hang. Returns True when this
+    call performed (or completed) the resolution's broadcast exchange."""
     if store is ...:
         store = plan_store.get_store()
     transport = plan_broadcast.get_transport()
-    publish = transport is not None and plan_broadcast.is_leader()
+    multihost = isinstance(transport, plan_broadcast.MultihostTransport)
+    publish = (
+        transport is not None
+        and plan_broadcast.is_leader()
+        and not exchanged
+    )
     if store is None and not publish:
-        return
+        return False
+    digest = plan_io.plan_signature_digest(sig)
     wire_entry = {
         k: v for k, v in entry.items() if k in ("dispatch", "static", "dynamic")
     }
     try:
-        blob = plan_io.encode_plan(wire_entry, env_sig=key.env_snapshot)
+        blob = plan_io.encode_plan(
+            wire_entry, env_sig=key.env_snapshot, sig_digest=digest
+        )
     except Exception as e:
-        from .resilience.errors import InjectedFault
-
-        if not isinstance(e, InjectedFault):
-            raise
-        _chaos_miss("plan_serialize", e)
-        return
-    digest = plan_io.plan_signature_digest(sig)
+        _persist_failure(
+            "plan_serialize", e,
+            transport if (publish and multihost) else None, digest,
+        )
+        return publish and multihost
     if store is not None:
         store.write(digest, blob)
-    if publish:
-        try:
-            plan_broadcast.exchange_plan(digest, blob)
-        except Exception as e:
-            from .resilience.errors import InjectedFault
-
-            if not isinstance(e, InjectedFault):
-                raise
-            _chaos_miss("plan_broadcast", e)
+    if not publish:
+        return False
+    try:
+        plan_broadcast.exchange_plan(digest, blob)
+    except Exception as e:
+        _persist_failure(
+            "plan_broadcast", e, transport if multihost else None, digest
+        )
+    return True
 
 
 class DistAttnRuntimeMgr:
@@ -382,8 +443,12 @@ class DistAttnRuntimeMgr:
         # memory | disk | broadcast | cold (stamped on plan_solve telemetry)
         self.plan_source = "memory" if entry is not None else "cold"
         self._plan_meta: dict = {}
+        # True once this resolution's single collective broadcast exchange
+        # happened (leader publish-on-hit): later persists must not
+        # exchange again or hosts pair collectives off-by-one
+        bcast_exchanged = False
         if cache_on:
-            fetched, src, extra = _control_plane_lookup(
+            fetched, src, extra, bcast_exchanged = _control_plane_lookup(
                 sig, key, entry, self.plan_source
             )
             if entry is None and fetched is not None:
@@ -491,7 +556,9 @@ class DistAttnRuntimeMgr:
                             _mask_family(sig),
                             self.dynamic_plan.solver_state,
                         )
-                        _persist_entry(sig, key, new_entry)
+                        _persist_entry(
+                            sig, key, new_entry, exchanged=bcast_exchanged
+                        )
             if built_dynamic:
                 self.comm_meta = self.calc_meta = None
                 self.runtime = DynamicDistAttnRuntime(
@@ -539,7 +606,7 @@ class DistAttnRuntimeMgr:
                 )
                 new_entry["static"] = (self.comm_meta, self.calc_meta)
                 _PLAN_CACHE.store(sig, new_entry)
-                _persist_entry(sig, key, new_entry)
+                _persist_entry(sig, key, new_entry, exchanged=bcast_exchanged)
         overlap_cfg = key.config.overlap_config
         self.runtime = DistAttnRuntime(
             comm_meta=self.comm_meta,

@@ -13,7 +13,9 @@ one ``exchange`` interface:
   everyone receives it. Requires an initialized jax distributed client.
 - :class:`FileTransport` — shared-directory publish/poll
   (``MAGI_ATTENTION_PLAN_BROADCAST_DIR``). The leader atomically publishes
-  ``bcast-<digest>.bin`` (same tmp+rename idiom as plan_store); followers
+  ``bcast-<digest>.bin`` (same tmp+fsync+rename idiom as plan_store), and
+  on warm resolutions re-publishes any blob that went missing or corrupt
+  (:meth:`FileTransport.published_ok` heal probe); followers
   poll with bounded retry + exponential backoff under a hard deadline
   (``..._RETRIES`` / ``..._BACKOFF_MS`` / ``..._DEADLINE_MS``). This is the
   single-host test transport and the fallback for fleets without a jax
@@ -31,9 +33,11 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass
+from typing import Any
 
 from .. import telemetry
 from ..env import comm as env_comm
+from . import plan_io
 
 
 @dataclass
@@ -84,6 +88,8 @@ class FileTransport:
             os.makedirs(self.directory, exist_ok=True)
             with open(tmp, "wb") as f:
                 f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except OSError:
             try:
@@ -91,6 +97,20 @@ class FileTransport:
             except OSError:
                 pass
             telemetry.inc("plan_broadcast.publish_error")
+
+    def published_ok(self, digest: str, env_sig: Any = ()) -> bool:
+        """Whether the follower-observable blob for ``digest`` exists and
+        decodes cleanly under its binding — the warm leader's heal probe:
+        a missing or corrupt publish is re-published on the next warm
+        resolution instead of starving followers forever. Never raises."""
+        try:
+            with open(self.path_for(digest), "rb") as f:
+                plan_io.decode_plan(
+                    f.read(), env_sig=env_sig, expect_digest=digest
+                )
+            return True
+        except Exception:
+            return False
 
     def _receive(self, digest: str) -> BroadcastResult:
         path = self.path_for(digest)
@@ -124,27 +144,37 @@ class FileTransport:
 
 class MultihostTransport:
     """broadcast_one_to_all over the jax distributed client. Collective:
-    leader and followers must reach ``exchange`` once per resolution in the
-    same order — the manager guarantees that by exchanging on EVERY plan
-    resolution while this transport is active, hits included."""
+    leader and followers must reach ``exchange`` exactly once per plan
+    resolution in the same order — the manager guarantees that by
+    exchanging on EVERY resolution while this transport is active (hits
+    included) and never more than once (a leader that already exchanged
+    on a hit skips the persist-path publish). A leader whose blob could
+    not be built still exchanges, with a zero-length blob, so followers
+    blocked in their receive unblock into a local cold solve."""
 
     def exchange(self, digest: str, blob: bytes | None) -> BroadcastResult:
         import numpy as np
         from jax.experimental import multihost_utils
 
+        # ``blob is not None`` is the leader's side of the exchange: it
+        # must source both collectives, whatever its process index —
+        # MAGI_ATTENTION_PLAN_BROADCAST_ROLE may put the solver on a host
+        # other than jax process 0 (the collective's default source)
+        is_source = blob is not None
         payload = np.frombuffer(blob or b"", dtype=np.uint8)
         # two collectives: length first (followers size their buffer), then
         # the padded payload — call counts match on every host by design
         length = int(
             multihost_utils.broadcast_one_to_all(
-                np.array([payload.size], dtype=np.int64)
+                np.array([payload.size], dtype=np.int64),
+                is_source=is_source,
             )[0]
         )
         if length == 0:
             return BroadcastResult(None)
         buf = np.zeros(length, dtype=np.uint8)
         buf[: payload.size] = payload[:length]
-        out = multihost_utils.broadcast_one_to_all(buf)
+        out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
         return BroadcastResult(np.asarray(out).tobytes())
 
 

@@ -19,8 +19,9 @@ plan_broadcast.py wire tier). Design rules:
   topology the solver built — ``verify_runtime_mgr`` relies on
   ``dispatch_meta_kv is dispatch_meta_q`` to detect self-attention.
 - **Self-checking**: a fixed header (magic, wire version, env-signature
-  digest, payload length, payload sha256) makes truncation, bit-flips, stale
-  schemas and cross-environment reuse each detectable as a *typed* error
+  digest, plan-signature digest, payload length, payload sha256) makes
+  truncation, bit-flips, stale schemas, cross-environment reuse and
+  wrong-signature delivery each detectable as a *typed* error
   (:class:`PlanDecodeError` subclasses) before any object is built.
 
 The ``plan_serialize`` fault-injection site arms on every encode so the
@@ -37,9 +38,15 @@ from typing import Any, Callable
 import numpy as np
 
 MAGIC = b"MAGIPLAN"
-PLAN_WIRE_VERSION = 1
-# magic(8) + version(u32) + env digest(16) + payload len(u64) + sha256(32)
-HEADER = struct.Struct("<8sI16sQ32s")
+PLAN_WIRE_VERSION = 2
+# magic(8) + version(u32) + env digest(16) + plan-signature digest(16)
+# + payload len(u64) + sha256(32)
+HEADER = struct.Struct("<8sI16s16sQ32s")
+
+# header value of a blob encoded without a signature binding (direct
+# encode_plan calls, e.g. the verify_plans round-trip rider); the manager's
+# store/broadcast paths always bind
+_UNBOUND_SIG = b"\x00" * 16
 
 
 class PlanDecodeError(RuntimeError):
@@ -57,6 +64,12 @@ class PlanChecksumError(PlanDecodeError):
 
 class PlanEnvMismatchError(PlanDecodeError):
     """The blob was encoded under a different env signature."""
+
+
+class PlanSigMismatchError(PlanDecodeError):
+    """The blob is bound to a different plan-signature digest — a store
+    file renamed/copied across keys, or a broadcast blob delivered for the
+    wrong resolution (e.g. hosts pairing collectives off-by-one)."""
 
 
 # ---------------------------------------------------------------------------
@@ -465,9 +478,20 @@ def plan_signature_digest(sig: Any) -> str:
     return hashlib.sha256(encode_value(sig)).hexdigest()
 
 
-def encode_plan(obj: Any, env_sig: Any = ()) -> bytes:
+def _sig_digest_bytes(digest: str) -> bytes:
+    """16-byte header form of a plan-signature digest string."""
+    return hashlib.sha256(digest.encode("utf-8")).digest()[:16]
+
+
+def encode_plan(
+    obj: Any, env_sig: Any = (), sig_digest: str | None = None
+) -> bytes:
     """Serialize one plan-cache entry (or any registered plan object) into
-    a self-checking blob. Arms the ``plan_serialize`` injection site."""
+    a self-checking blob. ``sig_digest`` — the plan-signature digest the
+    blob is stored/broadcast under — is embedded in the header so a
+    delivered blob is bound to the signature it answers; the manager's
+    persist path always binds. Arms the ``plan_serialize`` injection
+    site."""
     from ..resilience.inject import maybe_inject
 
     maybe_inject("plan_serialize")
@@ -476,20 +500,29 @@ def encode_plan(obj: Any, env_sig: Any = ()) -> bytes:
         MAGIC,
         PLAN_WIRE_VERSION,
         env_sig_digest(env_sig),
+        _sig_digest_bytes(sig_digest) if sig_digest else _UNBOUND_SIG,
         len(payload),
         hashlib.sha256(payload).digest(),
     ) + payload
 
 
-def decode_plan(blob: bytes, env_sig: Any = ()) -> Any:
+def decode_plan(
+    blob: bytes, env_sig: Any = (), expect_digest: str | None = None
+) -> Any:
     """Decode + integrity-check one blob. Raises a typed
     :class:`PlanDecodeError` subclass on ANY corruption; the caller
-    (plan_store / plan_broadcast) turns that into a cache miss."""
+    (plan_store / plan_broadcast) turns that into a cache miss. With
+    ``expect_digest``, a blob bound to a different plan-signature digest
+    is a :class:`PlanSigMismatchError` — the guard against a store file
+    served under the wrong key or a broadcast blob delivered for the
+    wrong resolution (unbound blobs skip the check)."""
     if len(blob) < HEADER.size:
         raise PlanChecksumError(
             f"blob shorter than header ({len(blob)} < {HEADER.size})"
         )
-    magic, version, env_digest, length, digest = HEADER.unpack_from(blob)
+    magic, version, env_digest, sig_digest, length, digest = (
+        HEADER.unpack_from(blob)
+    )
     if magic != MAGIC:
         raise PlanSchemaError(f"bad magic {magic!r}")
     if version != PLAN_WIRE_VERSION:
@@ -499,6 +532,14 @@ def decode_plan(blob: bytes, env_sig: Any = ()) -> Any:
     if env_digest != env_sig_digest(env_sig):
         raise PlanEnvMismatchError(
             "plan encoded under a different env signature"
+        )
+    if (
+        expect_digest is not None
+        and sig_digest != _UNBOUND_SIG
+        and sig_digest != _sig_digest_bytes(expect_digest)
+    ):
+        raise PlanSigMismatchError(
+            "plan blob is bound to a different plan signature"
         )
     payload = blob[HEADER.size:]
     if len(payload) != length:

@@ -43,6 +43,7 @@ MISS_IO_ERROR = "io_error"
 MISS_SCHEMA = "schema"
 MISS_CHECKSUM = "checksum"
 MISS_ENV_MISMATCH = "env_mismatch"
+MISS_SIG_MISMATCH = "sig_mismatch"
 MISS_DECODE = "decode_error"
 MISS_VERIFY = "verify_reject"  # recorded by the manager after R1-R5 rejects
 
@@ -127,9 +128,13 @@ class PlanStore:
             self._record("read", miss=miss)
             return None, miss
         try:
-            entry = plan_io.decode_plan(blob, env_sig=env_sig)
+            entry = plan_io.decode_plan(
+                blob, env_sig=env_sig, expect_digest=digest
+            )
         except plan_io.PlanEnvMismatchError as e:
             miss = PlanStoreMiss(MISS_ENV_MISMATCH, str(e))
+        except plan_io.PlanSigMismatchError as e:
+            miss = PlanStoreMiss(MISS_SIG_MISMATCH, str(e))
         except plan_io.PlanSchemaError as e:
             miss = PlanStoreMiss(MISS_SCHEMA, str(e))
         except plan_io.PlanChecksumError as e:

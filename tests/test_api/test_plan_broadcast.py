@@ -1,0 +1,65 @@
+"""Transport-level unit tests for ``meta/plan_broadcast.py``.
+
+The manager-level broadcast behaviour (tier ladder, degradation,
+collective alignment) lives in tests/test_resilience/test_plan_chaos.py;
+here we pin the two transport contracts that only show up on real
+multihost fleets:
+
+- the multihost collective must source from whichever host HOLDS the
+  blob (``MAGI_ATTENTION_PLAN_BROADCAST_ROLE`` may pin the leader to a
+  non-zero host), not unconditionally from jax process 0;
+- the file transport's publishes are durable (fsync before rename) and
+  observable via the ``published_ok`` heal probe.
+"""
+
+from magiattention_tpu.meta import plan_broadcast, plan_io
+
+
+def test_multihost_collective_sources_from_the_blob_holder(monkeypatch):
+    from jax.experimental import multihost_utils
+
+    seen = []
+
+    def fake_broadcast(x, is_source=None):
+        seen.append(is_source)
+        return x
+
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", fake_broadcast)
+    t = plan_broadcast.MultihostTransport()
+
+    # leader (holds the blob): sources BOTH collectives — length then
+    # payload — whatever its process index
+    out = t.exchange("d", b"payload")
+    assert out.blob == b"payload"
+    assert seen == [True, True]
+
+    # the zero-length completion (persist failed) is still leader-sourced;
+    # followers decode blob=None into a local cold solve
+    seen.clear()
+    assert t.exchange("d", b"").blob is None
+    assert seen == [True]
+
+    # a follower is never a source
+    seen.clear()
+    assert t.exchange("d", None).blob is None
+    assert seen == [False]
+
+
+def test_file_publish_then_heal_probe(tmp_path):
+    t = plan_broadcast.FileTransport(str(tmp_path / "b"))
+    blob = plan_io.encode_plan({"x": 1}, sig_digest="d1")
+    assert not t.published_ok("d1")  # nothing published yet
+    t.exchange("d1", blob)
+    assert t.published_ok("d1")
+
+    # truncation (torn publish) fails the probe
+    path = t.path_for("d1")
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert not t.published_ok("d1")
+
+    # a pristine blob bound to a DIFFERENT signature also fails it —
+    # the probe checks the binding, not just the checksum
+    with open(path, "wb") as f:
+        f.write(plan_io.encode_plan({"x": 1}, sig_digest="other"))
+    assert not t.published_ok("d1")

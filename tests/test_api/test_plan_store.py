@@ -31,6 +31,7 @@ from magiattention_tpu.meta.plan_store import (
     MISS_CHECKSUM,
     MISS_ENV_MISMATCH,
     MISS_SCHEMA,
+    MISS_SIG_MISMATCH,
     PlanStore,
 )
 
@@ -153,6 +154,17 @@ def test_decode_corruption_matrix_raises_typed():
     # env signature mismatch
     with pytest.raises(plan_io.PlanEnvMismatchError):
         plan_io.decode_plan(blob, env_sig=("env-b",))
+    # blob bound to one plan signature, delivered for another
+    bound = plan_io.encode_plan({"x": 1}, env_sig=("env-a",), sig_digest="aa")
+    with pytest.raises(plan_io.PlanSigMismatchError):
+        plan_io.decode_plan(bound, env_sig=("env-a",), expect_digest="bb")
+    # matching binding decodes; unbound blobs skip the signature check
+    assert plan_io.decode_plan(
+        bound, env_sig=("env-a",), expect_digest="aa"
+    ) == {"x": 1}
+    assert plan_io.decode_plan(
+        blob, env_sig=("env-a",), expect_digest="aa"
+    ) == {"x": 1}
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +206,12 @@ def test_store_read_miss_matrix(tmp_path):
         f.write(blob)
     entry, miss = store.read("d1", env_sig=("env-b",))
     assert entry is None and miss.reason == MISS_ENV_MISMATCH
+
+    with open(path, "wb") as f:  # pristine blob bound to a different key
+        f.write(plan_io.encode_plan({"x": 1}, env_sig=env_sig,
+                                    sig_digest="other"))
+    entry, miss = store.read("d1", env_sig=env_sig)
+    assert entry is None and miss.reason == MISS_SIG_MISMATCH
 
 
 def test_crash_orphan_tmp_cleanup(tmp_path):

@@ -8,10 +8,10 @@ import numpy as np
 import pytest
 
 from magiattention_tpu import telemetry
-from magiattention_tpu.meta import plan_store
+from magiattention_tpu.meta import plan_broadcast, plan_store
 from magiattention_tpu.resilience.errors import InjectedFault
 
-from tests.test_resilience.conftest import make_mgr, run_step
+from tests.test_resilience.conftest import CHUNK, S, make_mesh, make_mgr, run_step
 
 pytestmark = pytest.mark.chaos
 
@@ -228,3 +228,178 @@ class TestBroadcastExhaustion:
         for path in stored:
             digest = path.name[len("plan-") : -len(".bin")]
             assert path.read_bytes() == published[f"bcast-{digest}.bin"]
+
+
+# ---------------------------------------------------------------------------
+# collective alignment: with a multihost (collective) transport every host
+# performs EXACTLY one broadcast exchange per plan resolution — hits,
+# re-solves and persist failures included — or later resolutions pair
+# collectives off-by-one across hosts (wrong blob / hang)
+# ---------------------------------------------------------------------------
+
+
+class _FakeCollective(plan_broadcast.MultihostTransport):
+    """MultihostTransport stand-in: records every collective exchange
+    instead of touching jax's distributed client, so single-process tests
+    can count the leader's exchanges per resolution."""
+
+    def __init__(self):
+        self.calls = []
+
+    def exchange(self, digest, blob):
+        self.calls.append((digest, blob))
+        return plan_broadcast.BroadcastResult(blob if blob else None)
+
+
+class TestCollectiveAlignment:
+    def test_leader_exchanges_exactly_once_per_resolution(self, monkeypatch):
+        """A cached static-fallback entry under a QO_COMM signature used to
+        make the leader exchange twice per resolution (publish-on-hit AND
+        the dynamic re-solve's persist) while followers exchange once —
+        desyncing every later collective pairing across hosts."""
+        import magiattention_tpu.meta._make_attn_meta as mam
+        from magiattention_tpu.api.magi_attn_interface import clear_cache
+
+        _clear_warm_tiers()
+        monkeypatch.setenv("MAGI_ATTENTION_QO_COMM", "1")
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        monkeypatch.setenv("MAGI_ATTENTION_PLAN_BROADCAST_ROLE", "leader")
+        fake = _FakeCollective()
+        monkeypatch.setattr(plan_broadcast, "get_transport", lambda: fake)
+
+        real_solve = mam.make_dynamic_attn_plan
+
+        def failing_solve(*a, **kw):
+            raise RuntimeError("transient dynamic-solve failure")
+
+        # resolution 1 (cold): the dynamic solve fails, the static
+        # fallback entry is cached; its persist is the one exchange
+        monkeypatch.setattr(mam, "make_dynamic_attn_plan", failing_solve)
+        make_mgr()
+        assert len(fake.calls) == 1
+        # resolution 2 (memory hit lacking the dynamic artifact): the
+        # publish-on-hit is THE exchange — the successful dynamic
+        # re-solve's persist must not exchange a second time. Drop only
+        # the manager-level LRU so the plan memory tier stays warm.
+        clear_cache()
+        monkeypatch.setattr(mam, "make_dynamic_attn_plan", real_solve)
+        make_mgr()
+        assert len(fake.calls) == 2
+
+    def test_cold_leader_persist_failure_still_completes_exchange(
+        self, monkeypatch
+    ):
+        """Multihost followers are already blocked in their receive when
+        the cold leader persists: an encode failure must still complete
+        the collective with a zero-length blob (followers degrade to a
+        local cold solve) instead of hanging the fleet."""
+        _clear_warm_tiers()
+        monkeypatch.setenv("MAGI_ATTENTION_PLAN_BROADCAST_ROLE", "leader")
+        fake = _FakeCollective()
+        monkeypatch.setattr(plan_broadcast, "get_transport", lambda: fake)
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_serialize")
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        make_mgr()
+        assert [blob for _, blob in fake.calls] == [b""]
+
+    def test_persist_failure_completes_exchange_even_on_typed_raise(
+        self, monkeypatch
+    ):
+        _clear_warm_tiers()
+        monkeypatch.setenv("MAGI_ATTENTION_PLAN_BROADCAST_ROLE", "leader")
+        fake = _FakeCollective()
+        monkeypatch.setattr(plan_broadcast, "get_transport", lambda: fake)
+        monkeypatch.setenv("MAGI_ATTENTION_FAULT_INJECT", "plan_serialize")
+        with pytest.raises(InjectedFault, match="plan_serialize"):
+            make_mgr()
+        assert [blob for _, blob in fake.calls] == [b""]
+
+    def test_genuine_persist_error_is_recorded_not_raised(
+        self, monkeypatch, tmp_path
+    ):
+        """'Never costs the step': a genuine (non-injected) encode error
+        is a recorded degradation, not an exception out of the build —
+        even with MAGI_ATTENTION_FALLBACK unset."""
+        import magiattention_tpu.dist_attn_runtime_mgr as mgr_mod
+
+        _clear_warm_tiers()
+        _enable_telemetry(monkeypatch, tmp_path)
+        _enable_store(monkeypatch, tmp_path)
+
+        def boom(*a, **kw):
+            raise ValueError("genuine encode failure")
+
+        monkeypatch.setattr(mgr_mod.plan_io, "encode_plan", boom)
+        make_mgr()  # must not raise
+        counters = telemetry.get_collector().counters
+        assert counters.get("resilience.fallback", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# signature binding: a checksum-valid, verifier-clean blob delivered for
+# the WRONG mask signature is a typed miss -> cold solve, never a silently
+# wrong plan
+# ---------------------------------------------------------------------------
+
+
+class TestSignatureBinding:
+    def test_follower_rejects_blob_delivered_for_wrong_signature(
+        self, monkeypatch, tmp_path
+    ):
+        from magiattention_tpu.api import init_dist_attn_runtime_key
+        from magiattention_tpu.dist_attn_runtime_mgr import (
+            DistAttnRuntimeMgr,
+            _plan_signature,
+        )
+        from magiattention_tpu.meta import plan_io
+
+        _clear_warm_tiers()
+        _enable_telemetry(monkeypatch, tmp_path)
+        bdir = _enable_broadcast(monkeypatch, tmp_path, role="leader")
+        run_step(make_mgr())  # leader publishes mask A's plan
+        (blob_a_path,) = bdir.glob("bcast-*.bin")
+        blob_a = blob_a_path.read_bytes()
+        # key creation eagerly plans mask B too — drop every warm tier
+        # after it so the follower resolution below must hit the wire
+        mesh = make_mesh()
+        key_b = init_dist_attn_runtime_key(
+            [[0, 2 * S]], [[0, 2 * S]], ["causal"], 2 * S, 2 * S, CHUNK,
+            mesh=mesh,
+        )
+        _clear_warm_tiers()
+        # deliver mask A's blob under mask B's digest — the observable
+        # symptom of hosts pairing broadcast exchanges off-by-one
+        digest_b = plan_io.plan_signature_digest(_plan_signature(key_b))
+        (bdir / f"bcast-{digest_b}.bin").write_bytes(blob_a)
+        _enable_broadcast(monkeypatch, tmp_path, role="follower")
+        mgr_b = DistAttnRuntimeMgr(key_b, mesh)
+        assert mgr_b.plan_source == "cold"
+        counters = telemetry.get_collector().counters
+        assert counters.get("resilience.reject", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# publish healing: a crash-corrupted (or lost) published blob is healed by
+# the next warm leader resolution instead of starving followers forever
+# ---------------------------------------------------------------------------
+
+
+class TestPublishHeal:
+    def test_warm_leader_republishes_missing_or_corrupt_blob(
+        self, monkeypatch, tmp_path
+    ):
+        from magiattention_tpu.api.magi_attn_interface import clear_cache
+
+        _clear_warm_tiers()
+        bdir = _enable_broadcast(monkeypatch, tmp_path, role="leader")
+        make_mgr()  # cold solve publishes
+        (blob_path,) = bdir.glob("bcast-*.bin")
+        pristine = blob_path.read_bytes()
+        blob_path.write_bytes(pristine[: len(pristine) // 2])  # torn publish
+        clear_cache()  # manager LRU only: the plan memory tier stays warm
+        make_mgr()  # warm memory hit: the heal probe republishes
+        assert blob_path.read_bytes() == pristine
+        blob_path.unlink()  # lost publish
+        clear_cache()
+        make_mgr()
+        assert blob_path.read_bytes() == pristine
