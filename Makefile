@@ -5,9 +5,10 @@ PY ?= python
 
 .PHONY: test test-all test-slow bench dryrun smoke queue fit-overhead \
 	telemetry-smoke analysis lint verify-plans kernel-audit chaos \
-	serve-smoke perf-gate nsa-needle-smoke plan-cache-smoke
+	serve-smoke perf-gate nsa-needle-smoke plan-cache-smoke \
+	straggler-smoke
 
-test: analysis chaos serve-smoke plan-cache-smoke  ## fast tier: the correctness surface in < 5 min on one core
+test: analysis chaos serve-smoke plan-cache-smoke straggler-smoke  ## fast tier: the correctness surface in < 5 min on one core
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 test-all: analysis  ## everything: + model training, scale oracles, property suites
@@ -53,7 +54,7 @@ perf-gate:  ## fail on >10% bench regression vs prior run without a BENCH note
 	$(PY) scripts/perf_gate.py
 
 chaos:  ## fault-injection chaos matrix: every site recovers or raises typed
-	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 		$(PY) -m pytest tests/test_resilience -x -q -m chaos
 
 nsa-needle-smoke:  ## needle-in-haystack retrieval through the gather-free NSA kernel (CPU interpret)
@@ -64,3 +65,6 @@ serve-smoke:  ## CPU continuous-batching end-to-end: engine bitwise vs replay
 
 plan-cache-smoke:  ## two-process plan-store proof: warm start with zero solves + corruption heal
 	JAX_PLATFORMS=cpu $(PY) scripts/plan_cache_smoke.py
+
+straggler-smoke:  ## fake-clock straggler cycle: detect -> weighted re-solve -> recover (2 builds)
+	JAX_PLATFORMS=cpu $(PY) scripts/straggler_smoke.py

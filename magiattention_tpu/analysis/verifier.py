@@ -147,6 +147,7 @@ def check_dispatch(
     dispatch_meta,
     bucket=None,
     balance_bound: float = 2.0,
+    capacities=None,
 ) -> None:
     """R2: the chunk->rank assignment partitions the sequence exactly once.
 
@@ -154,6 +155,12 @@ def check_dispatch(
     balance lower bound ``max(ceil(total/cp), max_chunk_area)`` — exceeding
     it is a warning (the AUTO dispatcher may trade balance for comm volume
     on purpose), never an error.
+
+    ``capacities`` (per-rank weights, see dispatch_solver.solve) switches
+    the balance sub-check to its weighted form: per-rank completion time is
+    ``area_r / w_r`` and the lower bound is
+    ``max(total/sum(w_active), max_chunk/max_w)``. A rank with zero weight
+    owning any chunk is an ERROR — a drained rank must receive no work.
     """
     report.mark_run("R2")
     meta = dispatch_meta
@@ -200,22 +207,61 @@ def check_dispatch(
             f"chunks never dispatched (rows fall out of the attention): "
             f"{dropped[:8]}{'...' if len(dropped) > 8 else ''}",
         )
+    caps = None
+    if capacities is not None and len(meta.partitions) == meta.cp_size:
+        caps = [float(w) for w in capacities]
+        if len(caps) != meta.cp_size:
+            report.add(
+                "R2", ERROR, site,
+                f"{len(caps)} capacity weights != cp_size {meta.cp_size}",
+            )
+            caps = None
+        else:
+            for r, part in enumerate(meta.partitions):
+                if caps[r] <= 0.0 and len(part) > 0:
+                    report.add(
+                        "R2", ERROR, f"rank {r}",
+                        f"drained rank (capacity {caps[r]}) owns "
+                        f"{len(part)} chunks — zero-weight ranks must "
+                        "receive no work",
+                    )
     if bucket is not None and not dropped and meta.cp_size > 0:
         areas = {c.chunk_id: c.area for c in bucket.q_chunks}
         if len(areas) == num_chunks and sum(areas.values()) > 0:
             per_rank = [
                 sum(areas[c] for c in part) for part in meta.partitions
             ]
-            lb = max(
-                -(-sum(areas.values()) // meta.cp_size), max(areas.values())
-            )
-            if lb and max(per_rank) > balance_bound * lb:
-                report.add(
-                    "R2", WARNING, site,
-                    f"per-rank area {max(per_rank)} exceeds balance bound "
-                    f"{balance_bound} x lower bound {lb} "
-                    f"(per_rank={per_rank})",
+            if caps is not None and any(w > 0 for w in caps):
+                active = [w for w in caps if w > 0]
+                lb = max(
+                    sum(areas.values()) / sum(active),
+                    max(areas.values()) / max(active),
                 )
+                times = [
+                    per_rank[r] / caps[r]
+                    for r in range(meta.cp_size)
+                    if caps[r] > 0
+                ]
+                if lb and times and max(times) > balance_bound * lb:
+                    report.add(
+                        "R2", WARNING, site,
+                        f"weighted per-rank time {max(times):.1f} exceeds "
+                        f"balance bound {balance_bound} x weighted lower "
+                        f"bound {lb:.1f} (per_rank={per_rank}, "
+                        f"capacities={caps})",
+                    )
+            else:
+                lb = max(
+                    -(-sum(areas.values()) // meta.cp_size),
+                    max(areas.values()),
+                )
+                if lb and max(per_rank) > balance_bound * lb:
+                    report.add(
+                        "R2", WARNING, site,
+                        f"per-rank area {max(per_rank)} exceeds balance "
+                        f"bound {balance_bound} x lower bound {lb} "
+                        f"(per_rank={per_rank})",
+                    )
 
 
 # ---------------------------------------------------------------------------
@@ -825,6 +871,7 @@ def verify_plan(
     tile_geom=None,
     split_alignment: int = 128,
     balance_bound: float = 2.0,
+    capacities=None,
 ) -> VerifyReport:
     """Run every rule the supplied metadata allows; returns a VerifyReport.
 
@@ -839,6 +886,8 @@ def verify_plan(
             seqlens default to the calc_meta merged geometry.
         split_alignment: the declared wire alignment (GrpCollConfig).
         balance_bound: declared R2 per-rank area bound (x lower bound).
+        capacities: per-rank weight vector; switches the R2 balance
+            sub-check to its weighted form (see check_dispatch).
     """
     report = VerifyReport()
     if global_slices is not None:
@@ -847,7 +896,8 @@ def verify_plan(
         check_bucket(report, bucket)
     if dispatch_meta is not None:
         check_dispatch(
-            report, dispatch_meta, bucket=bucket, balance_bound=balance_bound
+            report, dispatch_meta, bucket=bucket,
+            balance_bound=balance_bound, capacities=capacities,
         )
     if calc_meta is not None:
         for r, arg in enumerate(calc_meta.host_args):
@@ -958,6 +1008,7 @@ def verify_runtime_mgr(mgr, balance_bound: float = 2.0) -> VerifyReport:
         ),
         split_alignment=align,
         balance_bound=balance_bound,
+        capacities=getattr(mgr.key, "capacities", None),
     )
     # R5 over the blocks the kernels will resolve for the merged geometry
     from ..kernels.ffa import default_blocks, resolve_bwd_overrides

@@ -28,6 +28,7 @@ from ..dist_attn_runtime_mgr import (
 )
 from ..env import snapshot_env
 from ..env import general as env_general
+from ..telemetry import health as telemetry_health
 from .functools import infer_attn_mask_from_cu_seqlens
 
 
@@ -192,6 +193,10 @@ def magi_attn_flex_key(
         mesh_sig=_mesh_signature(mesh),
         config=config,
         env_snapshot=snapshot_env(),
+        # straggler-aware elastic dispatch: the active capacity vector
+        # rides the key, so the plan re-solves exactly when it changes
+        # (None when detection is off or every rank is healthy)
+        capacities=telemetry_health.active_capacities(cp_size),
     )
     _runtime_dict.get_or_create(key, mesh)
     _most_recent_key = key
@@ -279,6 +284,9 @@ def make_flex_key_for_new_mask_after_dispatch(
         fixed_partitions=tuple(
             tuple(p) for p in mgr0.dispatch_meta_q.partitions
         ),
+        # the pinned partitions already embody the dispatch key's capacity
+        # weighting; carry the vector so the signature stays consistent
+        capacities=old.capacities,
     )
     _runtime_dict.get_or_create(key, mgr0.mesh)
     _most_recent_key = key

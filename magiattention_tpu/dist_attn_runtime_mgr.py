@@ -87,6 +87,11 @@ class DistAttnRuntimeKey:
     # dispatch (ref api :1172 make_*_key_for_new_mask_after_dispatch) so the
     # new mask reuses the old dispatch solution
     fixed_partitions: tuple[tuple[int, ...], ...] | None = None
+    # per-rank capacity vector from straggler detection (telemetry/health):
+    # None = uniform. A changed vector is a changed key, so the runtime
+    # re-solves exactly when the vector changes and the plan control plane
+    # caches/persists/broadcasts weighted plans like any other.
+    capacities: tuple[float, ...] | None = None
 
 
 def _plan_signature(key: DistAttnRuntimeKey) -> tuple:
@@ -95,8 +100,10 @@ def _plan_signature(key: DistAttnRuntimeKey) -> tuple:
     The runtime key minus the parts that only affect traced execution:
     device ids (mesh_sig[1] — the same plan is valid on any device
     assignment of the same axis layout) and head_axis (TP sharding of the
-    already-solved plan)."""
-    return (
+    already-solved plan). The capacity vector is appended ONLY when
+    non-uniform: uniform signatures stay byte-identical to builds without
+    capacity support, so warm plan stores are never invalidated."""
+    sig = (
         key.q_ranges,
         key.k_ranges,
         key.attn_mask_type,
@@ -110,6 +117,9 @@ def _plan_signature(key: DistAttnRuntimeKey) -> tuple:
         key.env_snapshot,
         key.fixed_partitions,
     )
+    if key.capacities is not None:
+        sig = sig + (("capacities", key.capacities),)
+    return sig
 
 
 def _mask_family(sig: tuple) -> tuple:
@@ -479,6 +489,11 @@ class DistAttnRuntimeMgr:
                     preset_partitions=(
                         [list(p) for p in key.fixed_partitions]
                         if key.fixed_partitions is not None
+                        else None
+                    ),
+                    capacities=(
+                        list(key.capacities)
+                        if key.capacities is not None
                         else None
                     ),
                 )

@@ -5,5 +5,5 @@ All runtime toggles are read through typed getter functions (never raw
 the runtime cache key via :func:`snapshot_env`.
 """
 
-from . import comm, general, kernel, resilience, serve  # noqa: F401
+from . import comm, general, health, kernel, resilience, serve  # noqa: F401
 from .general import snapshot_env  # noqa: F401

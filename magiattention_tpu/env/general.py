@@ -18,6 +18,10 @@ def _get_int(name: str, default: int) -> int:
     return int(os.environ.get(name, str(default)))
 
 
+def _get_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, str(default)))
+
+
 def _get_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 

@@ -9,7 +9,7 @@ tests/test_resilience/test_inject.py::test_off_means_noop.
 
 from __future__ import annotations
 
-from .general import _get_bool, _get_str
+from .general import _get_bool, _get_int, _get_str
 
 
 def fault_inject_spec() -> str:
@@ -39,9 +39,22 @@ def is_fallback_enable() -> bool:
     return _get_bool("MAGI_ATTENTION_FALLBACK")
 
 
+def step_retries() -> int:
+    """Step-watchdog retry budget (resilience/watchdog.py): a kernel
+    failure or numeric-guard trip inside ``calc_attn`` retries the step
+    through the backend registry's next rung, at most this many extra
+    attempts. 0 (default) disables the watchdog entirely — failures
+    propagate exactly as before. Deliberately NOT a [key] flag: the
+    watchdog changes execution, not the plan."""
+    return max(0, _get_int("MAGI_ATTENTION_STEP_RETRIES", 0))
+
+
 def is_resilience_active() -> bool:
-    """ONE gate for the guarded call paths: any of the three flags set.
+    """ONE gate for the guarded call paths: any of the flags set.
     Kept to a few dict lookups so the off path stays free."""
     return bool(
-        fault_inject_spec() or numeric_guard_policy() or is_fallback_enable()
+        fault_inject_spec()
+        or numeric_guard_policy()
+        or is_fallback_enable()
+        or step_retries()
     )

@@ -17,7 +17,12 @@ from ..common.ranges import AttnRanges
 from .collection.dispatch_meta import DispatchMeta
 from .container.bucket import AttnBucket, AttnChunk
 from .container.slice import AttnSlice
-from .solver.dispatch_solver import DispatchConfig, DispatchSolver
+from .solver.dispatch_solver import (
+    DispatchConfig,
+    DispatchSolution,
+    DispatchSolver,
+    normalize_capacities,
+)
 from ..utils.profiling import instrument_host
 
 _logger = logging.getLogger("magiattention_tpu.dispatch")
@@ -111,6 +116,41 @@ def _solve_partitions_with_alg(
             for chunk in bucket.q_chunks
         ]
     return solver.solve(areas, cp_size, affinities=affinities).partitions
+
+
+def _solve_weighted_partitions(
+    areas: list[int],
+    cp_size: int,
+    dispatch_config: DispatchConfig,
+    caps: tuple[float, ...],
+) -> DispatchSolution | None:
+    """Capacity-weighted solve. Always the weighted LPT — it bypasses AUTO
+    and the native minheap, both of which solve the equal-count uniform
+    problem. The ``weighted_solve`` chaos site covers this path: an injected
+    fault degrades to the uniform all-ones solve (returns None) when
+    fallback is enabled, else propagates typed."""
+    from ..resilience.inject import maybe_inject
+
+    try:
+        maybe_inject("weighted_solve")
+    except Exception as e:
+        from ..resilience.errors import InjectedFault
+
+        if not isinstance(e, InjectedFault):
+            raise
+        from ..env import resilience as env_resilience
+
+        if not env_resilience.is_fallback_enable():
+            raise
+        from ..resilience.fallback import record_resilience_event
+
+        record_resilience_event(
+            "fallback", "weighted_solve",
+            action_detail="uniform_solve", error=type(e).__name__,
+        )
+        return None
+    solver = DispatchSolver(alg=dispatch_config.alg, config=dispatch_config)
+    return solver.solve(areas, cp_size, capacities=caps)
 
 
 def estimate_remote_rows_per_rank(
@@ -225,6 +265,7 @@ def make_dispatch_meta_from_qk_ranges(
     cp_size: int,
     dispatch_config: DispatchConfig | None = None,
     preset_partitions: list[list[int]] | None = None,
+    capacities: list[float] | None = None,
 ) -> tuple[DispatchMeta, DispatchMeta, AttnBucket]:
     """Build (q_meta, kv_meta, global_bucket) for self-attention.
 
@@ -249,6 +290,15 @@ def make_dispatch_meta_from_qk_ranges(
     areas = bucket.areas_per_chunk
 
     chosen_alg = dispatch_config.alg
+    weighted_sol: DispatchSolution | None = None
+    caps = normalize_capacities(capacities, cp_size)
+    if caps is not None and preset_partitions is None and cp_size > 1:
+        weighted_sol = _solve_weighted_partitions(
+            areas, cp_size, dispatch_config, caps
+        )
+        if weighted_sol is None:
+            caps = None  # chaos degraded: uniform all-ones plan
+
     if preset_partitions is not None:
         # re-keying after dispatch: reuse a prior dispatch solution for a
         # new mask (ref api :1172) — no balance guarantee for the new mask
@@ -256,6 +306,9 @@ def make_dispatch_meta_from_qk_ranges(
         chosen_alg = None
     elif cp_size == 1:
         partitions = [list(range(num_chunks))]
+        chosen_alg = None
+    elif weighted_sol is not None:
+        partitions = weighted_sol.partitions
         chosen_alg = None
     elif dispatch_config.alg == DispatchAlgType.AUTO:
         kv_own = None
@@ -290,12 +343,23 @@ def make_dispatch_meta_from_qk_ranges(
         lb = max(
             -(-sum(areas) // cp_size), max(areas, default=0)
         ) if areas else 0
+        extra = {}
+        if weighted_sol is not None:
+            extra = {
+                "capacities": list(weighted_sol.capacities or ()),
+                "weighted_makespan": weighted_sol.weighted_makespan,
+                "weighted_lower_bound": weighted_sol.weighted_lower_bound,
+            }
         telemetry.record_event(
             "dispatch_meta",
             alg=(
                 chosen_alg.value
                 if isinstance(chosen_alg, DispatchAlgType)
-                else ("preset" if preset_partitions is not None else "trivial")
+                else (
+                    "weighted" if weighted_sol is not None
+                    else "preset" if preset_partitions is not None
+                    else "trivial"
+                )
             ),
             total_seqlen_q=total_seqlen_q,
             total_seqlen_k=total_seqlen_k,
@@ -305,7 +369,11 @@ def make_dispatch_meta_from_qk_ranges(
             per_rank_area=per_rank,
             max_area=max_area,
             lower_bound=lb,
-            balance_ratio=(lb / max_area) if max_area else 1.0,
+            balance_ratio=(
+                weighted_sol.balance_ratio if weighted_sol is not None
+                else (lb / max_area) if max_area else 1.0
+            ),
+            **extra,
         )
 
     is_cross = total_seqlen_k != total_seqlen_q

@@ -22,8 +22,10 @@ from __future__ import annotations
 
 import copy
 import heapq
+import math
 import random
 from dataclasses import dataclass, field
+from typing import Sequence
 
 from ...common.enum import DispatchAlgType
 from ...common.range import AttnRange
@@ -116,14 +118,52 @@ class IOUAffinity(BaseDispatchAffinity):
         return f"IOUAffinity({self.iou_ranges})"
 
 
+def normalize_capacities(
+    capacities: Sequence[float] | None, cp_size: int
+) -> tuple[float, ...] | None:
+    """Canonicalize a per-rank capacity vector.
+
+    ``None`` and any all-equal positive vector (e.g. all-ones) mean *uniform*
+    and normalize to ``None``, so the uniform path — solver output, plan
+    signature, warm plan stores — stays byte-identical to a build without
+    capacities. A genuinely non-uniform vector comes back as a float tuple;
+    a zero entry drains that rank entirely.
+    """
+    if capacities is None:
+        return None
+    caps = tuple(float(c) for c in capacities)
+    if len(caps) != cp_size:
+        raise ValueError(
+            f"capacities has {len(caps)} entries for cp_size {cp_size}"
+        )
+    if any(not math.isfinite(c) or c < 0.0 for c in caps):
+        raise ValueError(f"capacities must be finite and >= 0, got {caps}")
+    if all(c == 0.0 for c in caps):
+        raise ValueError("all ranks drained: capacities are all zero")
+    if all(c == caps[0] for c in caps):
+        return None
+    return caps
+
+
 @dataclass
 class DispatchSolution:
     partitions: list[list[int]]  # chunk ids per rank, each sorted ascending
     max_area: int
     lower_bound: int
+    # weighted solve only (uniform solves leave all three None so the
+    # dataclass surface stays identical to the all-ones build)
+    capacities: tuple[float, ...] | None = None
+    weighted_makespan: float | None = None
+    weighted_lower_bound: float | None = None
 
     @property
     def balance_ratio(self) -> float:
+        if self.capacities is not None:
+            # weighted form: per-rank completion time is area/capacity and
+            # the ratio compares the weighted makespan to its lower bound
+            if not self.weighted_makespan:
+                return 1.0
+            return (self.weighted_lower_bound or 0.0) / self.weighted_makespan
         return self.lower_bound / self.max_area if self.max_area else 1.0
 
 
@@ -141,10 +181,37 @@ class DispatchSolver:
         sample_ids: list[int] | None = None,
         seed: int = 0,
         affinities: list[BaseDispatchAffinity] | None = None,
+        capacities: Sequence[float] | None = None,
     ) -> DispatchSolution:
         n = len(areas)
         lb = self._lower_bound(areas, cp_size)
         alg = self.alg
+
+        caps = normalize_capacities(capacities, cp_size)
+        if caps is not None:
+            # weighted makespan: target per-rank area proportional to
+            # capacity, zero-capacity ranks drained (empty shard). Chunk
+            # counts are inherently unequal, so shards pad like uneven_shard.
+            parts = self._weighted_lpt(areas, cp_size, caps)
+            parts = [sorted(p) for p in parts]
+            per_rank = [sum(areas[i] for i in p) for p in parts]
+            makespan = max(
+                (per_rank[r] / caps[r] for r in range(cp_size) if caps[r] > 0),
+                default=0.0,
+            )
+            return self._record(
+                DispatchSolution(
+                    partitions=parts,
+                    max_area=max(per_rank, default=0),
+                    lower_bound=lb,
+                    capacities=caps,
+                    weighted_makespan=makespan,
+                    weighted_lower_bound=self._weighted_lower_bound(
+                        areas, caps
+                    ),
+                ),
+                alg, n, cp_size, areas,
+            )
 
         if self.config.uneven_shard:
             # unequal chunk counts: pure min-makespan (LPT greedy, or exact
@@ -215,6 +282,13 @@ class DispatchSolver:
         the chosen assignment's record is the later ``dispatch_meta`` kind,
         _make_dispatch_meta.py)."""
         if telemetry.enabled():
+            extra = {}
+            if sol.capacities is not None:
+                extra = {
+                    "capacities": list(sol.capacities),
+                    "weighted_makespan": sol.weighted_makespan,
+                    "weighted_lower_bound": sol.weighted_lower_bound,
+                }
             telemetry.record_event(
                 "dispatch_solve",
                 alg=alg.value if hasattr(alg, "value") else str(alg),
@@ -226,8 +300,49 @@ class DispatchSolver:
                 max_area=sol.max_area,
                 lower_bound=sol.lower_bound,
                 balance_ratio=sol.balance_ratio,
+                **extra,
             )
         return sol
+
+    # -- capacity-weighted solve ------------------------------------------
+
+    @staticmethod
+    def _weighted_lpt(
+        areas: list[int], cp: int, caps: tuple[float, ...]
+    ) -> list[list[int]]:
+        """Weighted LPT: biggest chunk to the rank minimizing the
+        *projected completion time* ``(load + area) / capacity`` (ties
+        prefer the faster rank — a slow rank must not absorb a large chunk
+        just because it is idle). Ranks with zero capacity are never
+        candidates, so they come back with empty partitions (drained).
+        O(n * cp) scan: projected time depends on the chunk, so a plain
+        load heap would misplace large chunks onto slow ranks."""
+        active = [r for r in range(cp) if caps[r] > 0.0]
+        order = sorted(range(len(areas)), key=lambda i: (-areas[i], i))
+        parts: list[list[int]] = [[] for _ in range(cp)]
+        loads = [0] * cp
+        for i in order:
+            r = min(
+                active,
+                key=lambda r: (
+                    (loads[r] + areas[i]) / caps[r], -caps[r], r
+                ),
+            )
+            parts[r].append(i)
+            loads[r] += areas[i]
+        return parts
+
+    @staticmethod
+    def _weighted_lower_bound(
+        areas: list[int], caps: tuple[float, ...]
+    ) -> float:
+        """Weighted analogue of ``_lower_bound``: no schedule can finish
+        before the capacity-share bound ``total / sum(w)`` nor before the
+        largest single chunk runs on the fastest rank."""
+        total = sum(areas)
+        wsum = sum(c for c in caps if c > 0.0)
+        wmax = max(caps)
+        return max(total / wsum, max(areas, default=0) / wmax)
 
     # -- uneven-shard variants --------------------------------------------
 

@@ -101,8 +101,16 @@ def run_calc_attn(runtime, q, k, v, return_max_logits: bool = False):
     """Guarded execution of one ``calc_attn`` step (both CP runtimes).
 
     Only reached when a resilience flag is set; the fast path in
-    ``functional/dist_attn.py`` bypasses this function entirely.
+    ``functional/dist_attn.py`` bypasses this function entirely. With
+    ``MAGI_ATTENTION_STEP_RETRIES`` > 0 the step watchdog governs instead:
+    bounded retry through backend rungs with numeric quarantine
+    (resilience/watchdog.py); otherwise behavior is exactly the
+    pre-watchdog chain below.
     """
+    if env_resilience.step_retries() > 0:
+        from .watchdog import run_with_watchdog
+
+        return run_with_watchdog(runtime, q, k, v, return_max_logits)
     stage = f"{type(runtime).__name__}.calc_attn"
     failures = kernel_failure_types()
     try:

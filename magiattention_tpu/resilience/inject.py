@@ -49,6 +49,9 @@ INJECTION_SITES: tuple[str, ...] = (
     "plan_serialize",     # plan wire encoding (meta/plan_io.py)
     "plan_cache_read",    # on-disk plan store read (meta/plan_store.py)
     "plan_broadcast",     # cross-host plan broadcast (meta/plan_broadcast.py)
+    "rank_health_read",   # capacity-vector read at key planning (telemetry/health.py)
+    "weighted_solve",     # capacity-weighted dispatch solve (meta/_make_dispatch_meta.py)
+    "step_retry",         # step-watchdog backend retry (resilience/watchdog.py)
 )
 
 

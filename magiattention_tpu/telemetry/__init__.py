@@ -31,6 +31,7 @@ from .registry import (  # noqa: F401
     stage_timer,
     summary,
 )
+from . import health  # noqa: F401
 from .stats import band_area  # noqa: F401
 from .store import (  # noqa: F401
     TelemetryStore,

@@ -113,6 +113,13 @@ def record_event(kind: str, **payload: Any) -> None:
     if not enabled():
         return
     get_collector().record_event(kind, payload)
+    if kind == "attn_step":
+        # straggler detection: fold per-rank wall times into the health
+        # monitor (no-op unless MAGI_ATTENTION_STRAGGLER_DETECT is on and
+        # the record carries rank_wall_ms)
+        from . import health as _health
+
+        _health.observe_attn_step(payload)
 
 
 def inc(name: str, n: int = 1) -> None:

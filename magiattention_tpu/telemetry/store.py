@@ -61,7 +61,9 @@ OBS_CAP = 512
 DRIFT_CAP = 256
 
 # collector kinds ingest_event aggregates into run history
-_HISTORY_KINDS = ("attn_step", "serve_step", "plan_solve")
+_HISTORY_KINDS = ("attn_step", "serve_step", "plan_solve", "step_retry")
+# collector kinds with dedicated fold logic besides run history
+_SPECIAL_KINDS = ("model_drift", "rank_health")
 # attn_step fields forming the run-history key (ISSUE: mask-class
 # signature, shape, dtype, mesh, env snapshot)
 _ATTN_KEY_FIELDS = (
@@ -94,6 +96,8 @@ class StoreState:
     calibration: dict[str, dict[str, Any]] = field(default_factory=dict)
     observations: dict[str, list[dict[str, Any]]] = field(default_factory=dict)
     drift: list[dict[str, Any]] = field(default_factory=list)
+    rank_health: dict[str, dict[str, Any]] = field(default_factory=dict)
+    quarantine: dict[str, dict[str, Any]] = field(default_factory=dict)
 
 
 def _apply(state: StoreState, row: dict[str, Any]) -> None:
@@ -165,6 +169,44 @@ def _apply(state: StoreState, row: dict[str, Any]) -> None:
         )
         if len(state.drift) > DRIFT_CAP:
             del state.drift[: len(state.drift) - DRIFT_CAP]
+    elif rk == "rank_health":
+        r = str(row.get("rank"))
+        h = state.rank_health.setdefault(
+            r,
+            {
+                "count": 0,
+                "transitions": 0,
+                "ewma_ms": None,
+                "capacity": 1.0,
+                "degraded": False,
+            },
+        )
+        h["count"] += 1
+        if row.get("ewma_ms") is not None:
+            h["ewma_ms"] = float(row["ewma_ms"])
+        if row.get("capacity") is not None:
+            cap = float(row["capacity"])
+            if cap != h["capacity"]:
+                h["transitions"] += 1
+            h["capacity"] = cap
+        h["degraded"] = bool(row.get("degraded", False))
+        h["last_ts"] = row.get("ts")
+    elif rk == "quarantine":
+        qkey = f"{row.get('decision')}|{row.get('key')}|{row.get('backend')}"
+        if row.get("action") == "clear":
+            state.quarantine.pop(qkey, None)
+        else:
+            q = state.quarantine.setdefault(
+                qkey,
+                {
+                    "decision": row.get("decision"),
+                    "key": row.get("key"),
+                    "backend": row.get("backend"),
+                    "trips": 0,
+                },
+            )
+            q["trips"] = max(q["trips"], int(row.get("trips", 1)))
+            q["last_ts"] = row.get("ts")
     # unknown rk: forward-compat skip
 
 
@@ -181,6 +223,8 @@ def _load_from_disk(directory: str) -> StoreState:
             state.calibration = snap.get("calibration", {})
             state.observations = snap.get("observations", {})
             state.drift = snap.get("drift", [])
+            state.rank_health = snap.get("rank_health", {})
+            state.quarantine = snap.get("quarantine", {})
     except (OSError, ValueError):
         pass  # no/garbled snapshot: rebuild from history alone
     for path in sorted(glob.glob(os.path.join(directory, f"{HISTORY_PREFIX}-*.jsonl"))):
@@ -259,6 +303,8 @@ class TelemetryStore:
                         "calibration": state.calibration,
                         "observations": state.observations,
                         "drift": state.drift,
+                        "rank_health": state.rank_health,
+                        "quarantine": state.quarantine,
                     },
                     f,
                 )
@@ -359,6 +405,50 @@ class TelemetryStore:
         with self._lock:
             self._append({"rk": "drift", **_jsonable(row)})
 
+    def record_rank_health(
+        self,
+        rank: int,
+        wall_ms: float | None,
+        ewma_ms: float | None,
+        capacity: float,
+        degraded: bool,
+        **extra: Any,
+    ) -> None:
+        with self._lock:
+            row: dict[str, Any] = {
+                "rk": "rank_health",
+                "rank": int(rank),
+                "capacity": float(capacity),
+                "degraded": bool(degraded),
+            }
+            if wall_ms is not None:
+                row["wall_ms"] = float(wall_ms)
+            if ewma_ms is not None:
+                row["ewma_ms"] = float(ewma_ms)
+            if extra:
+                row["ctx"] = _jsonable(extra)
+            self._append(row)
+
+    def record_quarantine(
+        self,
+        decision: str,
+        key: Any,
+        backend: str,
+        trips: int,
+        action: str = "add",
+    ) -> None:
+        with self._lock:
+            self._append(
+                {
+                    "rk": "quarantine",
+                    "decision": decision,
+                    "key": canonical_key(key),
+                    "backend": backend,
+                    "trips": int(trips),
+                    "action": action,
+                }
+            )
+
     # -- readers ----------------------------------------------------------
 
     def policy_for(self, decision: str, key: Any) -> dict[str, Any] | None:
@@ -391,6 +481,23 @@ class TelemetryStore:
         with self._lock:
             c = self._ensure_loaded().calibration.get(name)
         return None if c is None else float(c["value"])
+
+    def rank_health_view(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            return {
+                r: dict(h)
+                for r, h in self._ensure_loaded().rank_health.items()
+            }
+
+    def quarantined(self, decision: str, key: Any) -> set[str]:
+        """Backends quarantined for a decision key (restart-persistent)."""
+        prefix = f"{decision}|{canonical_key(key)}|"
+        with self._lock:
+            return {
+                q["backend"]
+                for qkey, q in self._ensure_loaded().quarantine.items()
+                if qkey.startswith(prefix)
+            }
 
 
 # -- module-level gated access (what the registry / solvers use) ------------
@@ -481,6 +588,21 @@ def record_observation(
         st.record_observation(model, predicted, measured_ms, **extras)
 
 
+def quarantined_backends(decision: str, key: Any) -> set[str]:
+    """Restart-persistent quarantine set for a decision key; empty when
+    the store is inactive (quarantine still works in-process then)."""
+    st = get_store()
+    return set() if st is None else st.quarantined(decision, key)
+
+
+def record_quarantine(
+    decision: str, key: Any, backend: str, trips: int, action: str = "add"
+) -> None:
+    st = get_store()
+    if st is not None:
+        st.record_quarantine(decision, key, backend, trips, action=action)
+
+
 # -- collector ingest -------------------------------------------------------
 
 
@@ -516,7 +638,7 @@ def ingest_event(record: dict[str, Any]) -> None:
     Called for every record the collector writes; cheap kind/gate check
     first so non-store kinds cost one tuple membership test."""
     kind = record.get("kind")
-    if kind not in _HISTORY_KINDS and kind != "model_drift":
+    if kind not in _HISTORY_KINDS and kind not in _SPECIAL_KINDS:
         return
     if not store_active():
         return
@@ -594,6 +716,21 @@ def ingest_event(record: dict[str, Any]) -> None:
             if k in record
         }
         st.record_history("plan_solve", key, wall_ms)
+    elif kind == "rank_health":
+        st.record_rank_health(
+            rank=int(record.get("rank", -1)),
+            wall_ms=record.get("wall_ms"),
+            ewma_ms=record.get("ewma_ms"),
+            capacity=float(record.get("capacity", 1.0)),
+            degraded=bool(record.get("degraded", False)),
+        )
+    elif kind == "step_retry":
+        key = {
+            k: record.get(k)
+            for k in ("stage", "from_backend", "to_backend", "error")
+            if k in record
+        }
+        st.record_history("step_retry", key, wall_ms)
 
 
 def _kreg_last_key_or(default: Any) -> Any:

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Straggler-aware elastic dispatch smoke: detect -> rebalance -> recover.
+
+The ``make straggler-smoke`` gate (folded into ``make test``; ISSUE:
+straggler-aware elastic dispatch). One process, fake clock:
+
+1. Build the uniform plan and take a reference step.
+2. Feed the health monitor a persistent 4x straggler on the last rank
+   (synthetic wall times — no sleeping); detection must flip the rank to
+   capacity 0.25 after the hysteresis window, exactly once.
+3. Re-key: the weighted plan drains work off the straggler (max weighted
+   completion within 10% of the weighted ideal) and the step output stays
+   parity-correct vs the uniform plan.
+4. Heal the rank (walls drop to its capacity share of the healthy wall);
+   recovery must flip capacity back to 1.0 exactly once, and the uniform
+   re-key must reuse the warm plan — the whole cycle performs exactly TWO
+   plan builds (initial uniform + weighted), the recovery is a cache hit.
+
+Run directly::
+
+    JAX_PLATFORMS=cpu python scripts/straggler_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+S, CHUNK, CP = 256, 16, 4
+H, HK, D = 2, 1, 32
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+os.environ["MAGI_ATTENTION_PALLAS_INTERPRET"] = "1"
+os.environ["MAGI_ATTENTION_STRAGGLER_DETECT"] = "1"
+os.environ["MAGI_ATTENTION_STRAGGLER_MIN_STEPS"] = "4"
+os.environ["MAGI_ATTENTION_STRAGGLER_COOLDOWN"] = "2"
+os.environ["MAGI_ATTENTION_TELEMETRY"] = "1"
+os.environ["MAGI_ATTENTION_TELEMETRY_DIR"] = tempfile.mkdtemp(
+    prefix="straggler-smoke-tel-"
+)
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from magiattention_tpu import telemetry
+    from magiattention_tpu.api import init_dist_attn_runtime_mgr
+    from magiattention_tpu.telemetry import health
+
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:CP]), axis_names=("cp",)
+    )
+
+    def build_mgr():
+        return init_dist_attn_runtime_mgr(
+            [[0, S]], [[0, S]], ["causal"], S, S, CHUNK, mesh=mesh
+        )
+
+    def run_step(mgr):
+        rng = np.random.default_rng(0)
+        q = jax.numpy.asarray(
+            rng.standard_normal((S, H, D)), jax.numpy.float32
+        )
+        k = jax.numpy.asarray(
+            rng.standard_normal((S, HK, D)), jax.numpy.float32
+        )
+        v = jax.numpy.asarray(
+            rng.standard_normal((S, HK, D)), jax.numpy.float32
+        )
+        out_d, _ = mgr.calc_attn(
+            mgr.dispatch_qo(q), mgr.dispatch_kv(k), mgr.dispatch_kv(v)
+        )
+        return np.asarray(jax.block_until_ready(mgr.undispatch_qo(out_d)))
+
+    def solve_count():
+        return telemetry.get_collector().counters.get(
+            "events.dispatch_meta", 0
+        )
+
+    # 1. uniform baseline
+    mgr_u = build_mgr()
+    assert mgr_u.key.capacities is None, "healthy start must key uniform"
+    base_out = run_step(mgr_u)
+    builds_after_uniform = solve_count()
+    assert builds_after_uniform == 1, (
+        f"expected exactly 1 initial plan build, saw {builds_after_uniform}"
+    )
+
+    # 2. persistent 4x straggler on rank 3 (fake clock)
+    transitions = []
+    for _ in range(8):
+        for r in range(CP - 1):
+            health.observe_step(r, 10.0)
+        t = health.observe_step(CP - 1, 40.0)
+        if t:
+            transitions.append(t)
+    assert transitions == ["degraded"], (
+        f"expected exactly one degraded transition, saw {transitions}"
+    )
+    caps = health.active_capacities(CP)
+    assert caps == (1.0, 1.0, 1.0, 0.25), f"capacity vector {caps}"
+
+    # 3. weighted re-solve: balance + parity
+    mgr_w = build_mgr()
+    assert mgr_w.key.capacities == caps
+    assert solve_count() == 2, (
+        f"weighted re-key must cost exactly 1 more build, total "
+        f"{solve_count()}"
+    )
+    areas = {c.chunk_id: c.area for c in mgr_w.bucket.q_chunks}
+    per_rank = [
+        sum(areas[c] for c in p) for p in mgr_w.dispatch_meta_q.partitions
+    ]
+    lb = max(
+        sum(areas.values()) / sum(caps), max(areas.values()) / max(caps)
+    )
+    times = [per_rank[r] / caps[r] for r in range(CP)]
+    assert max(times) <= 1.10 * lb, (
+        f"weighted makespan {max(times):.0f} > 1.10 x ideal {lb:.0f} "
+        f"(per_rank={per_rank})"
+    )
+    out_w = run_step(mgr_w)
+    np.testing.assert_allclose(out_w, base_out, rtol=1e-5, atol=1e-5)
+
+    # 4. recovery: the straggler heals — its wall drops to the capacity
+    # share of the healthy wall (it runs 1/4 of the work now)
+    recovered = []
+    for _ in range(24):
+        for r in range(CP - 1):
+            health.observe_step(r, 10.0)
+        t = health.observe_step(CP - 1, 2.5)
+        if t:
+            recovered.append(t)
+    assert recovered == ["recovered"], (
+        f"expected exactly one recovered transition, saw {recovered}"
+    )
+    assert health.active_capacities(CP) is None
+    mgr_back = build_mgr()
+    assert mgr_back.key == mgr_u.key
+    assert mgr_back is mgr_u, "recovery must reuse the warm uniform plan"
+    assert solve_count() == 2, (
+        f"recovery must be a cache hit, saw {solve_count()} builds"
+    )
+    out_back = run_step(mgr_back)
+    np.testing.assert_array_equal(out_back, base_out)
+
+    print(
+        "straggler smoke OK: 1 degraded + 1 recovered transition, "
+        f"2 plan builds, weighted balance {max(times) / lb:.3f}x ideal, "
+        f"per_rank_area={per_rank}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

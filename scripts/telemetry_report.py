@@ -217,6 +217,21 @@ SECTION_SCHEMAS: dict[str, dict[str, str]] = {
         "worst": "the single worst finding (model, rel_err, predicted_ms, "
                  "measured_ms)",
     },
+    "rank_health": {
+        "observations": "rank_health records (one per observed step wall)",
+        "ranks": "distinct ranks observed",
+        "degraded_now": "ranks whose last record shows capacity < 1",
+        "transitions": "degraded/recovered transition counts",
+        "per_rank": "per rank: last ewma_ms, capacity, degraded flag",
+        "capacities_last": "capacity vector from each rank's last record",
+    },
+    "step_retry": {
+        "events": "step_retry records (one per failed watchdog attempt)",
+        "quarantines": "retries whose trip quarantined the backend",
+        "by_from_backend": "failed attempts per originating backend",
+        "by_error": "failed attempts per error type",
+        "last": "the most recent retry (stage, attempt, from, to, error)",
+    },
     "store": {
         "dir": "store directory read (--store)",
         "policy_entries": "persisted registry decisions",
@@ -226,6 +241,8 @@ SECTION_SCHEMAS: dict[str, dict[str, str]] = {
         "observations": "cost-model observation counts per model",
         "calibration": "fitted constants {name: {value, n}}",
         "drift_rows": "persisted drift findings",
+        "rank_health_rows": "persisted per-rank health aggregates",
+        "quarantine_rows": "persisted quarantined (decision, key, backend)",
     },
 }
 
@@ -663,6 +680,58 @@ def aggregate(records: list[dict]) -> dict:
             "by_model": {k: by_model[k] for k in sorted(by_model)},
             "worst": worst,
         }
+
+    health = kinds.get("rank_health", [])
+    if health:
+        per_rank: dict[int, dict] = {}
+        transitions: dict[str, int] = {}
+        for r in health:
+            rank = r.get("rank")
+            if rank is None:
+                continue
+            per_rank[int(rank)] = {
+                "ewma_ms": r.get("ewma_ms"),
+                "capacity": r.get("capacity"),
+                "degraded": r.get("degraded"),
+            }
+            t = r.get("transition")
+            if t:
+                transitions[t] = transitions.get(t, 0) + 1
+        ranks = sorted(per_rank)
+        agg["rank_health"] = {
+            "observations": len(health),
+            "ranks": len(per_rank),
+            "degraded_now": sum(
+                1 for d in per_rank.values() if d.get("degraded")
+            ),
+            "transitions": dict(sorted(transitions.items())),
+            "per_rank": {str(r): per_rank[r] for r in ranks},
+            "capacities_last": [per_rank[r].get("capacity") for r in ranks],
+        }
+
+    retries = kinds.get("step_retry", [])
+    if retries:
+        by_from: dict[str, int] = {}
+        by_error: dict[str, int] = {}
+        for r in retries:
+            fb = str(r.get("from_backend", "?"))
+            by_from[fb] = by_from.get(fb, 0) + 1
+            err = str(r.get("error", "?"))
+            by_error[err] = by_error.get(err, 0) + 1
+        last = retries[-1]
+        agg["step_retry"] = {
+            "events": len(retries),
+            "quarantines": sum(1 for r in retries if r.get("quarantined")),
+            "by_from_backend": dict(sorted(by_from.items())),
+            "by_error": dict(sorted(by_error.items())),
+            "last": {
+                k: last.get(k)
+                for k in (
+                    "stage", "attempt", "from_backend", "to_backend",
+                    "error",
+                )
+            },
+        }
     return agg
 
 
@@ -696,6 +765,8 @@ def aggregate_store(store_dir: str) -> dict:
             for k, v in sorted(state.calibration.items())
         },
         "drift_rows": len(state.drift),
+        "rank_health_rows": len(getattr(state, "rank_health", {})),
+        "quarantine_rows": len(getattr(state, "quarantine", {})),
     }
 
 
@@ -1044,6 +1115,46 @@ def format_summary(agg: dict) -> str:
                 f" vs measured {w['measured_ms']:.2f} ms"
             )
 
+    rh = agg.get("rank_health")
+    if rh:
+        lines.append("")
+        trans = (
+            " ".join(f"{k}={v}" for k, v in rh["transitions"].items())
+            or "none"
+        )
+        lines.append(
+            f"rank health: observations={rh['observations']} "
+            f"ranks={rh['ranks']} degraded_now={rh['degraded_now']} "
+            f"(transitions: {trans})"
+        )
+        for r, d in rh["per_rank"].items():
+            ewma = d.get("ewma_ms")
+            ewma_s = f"{ewma:.1f}" if ewma is not None else "?"
+            state = "DEGRADED" if d.get("degraded") else "healthy"
+            lines.append(
+                f"  rank {r}: ewma={ewma_s} ms "
+                f"capacity={d.get('capacity')} [{state}]"
+            )
+
+    sr = agg.get("step_retry")
+    if sr:
+        lines.append("")
+        froms = " ".join(
+            f"{k}={v}" for k, v in sr["by_from_backend"].items()
+        )
+        errs = " ".join(f"{k}={v}" for k, v in sr["by_error"].items())
+        lines.append(
+            f"step retries={sr['events']} quarantines={sr['quarantines']} "
+            f"(from: {froms}) (errors: {errs})"
+        )
+        last = sr.get("last") or {}
+        if last.get("from_backend") is not None:
+            lines.append(
+                f"  last: {last.get('stage')} attempt={last.get('attempt')} "
+                f"{last.get('from_backend')} -> {last.get('to_backend')} "
+                f"({last.get('error')})"
+            )
+
     so = agg.get("store")
     if so:
         lines.append("")
@@ -1059,6 +1170,12 @@ def format_summary(agg: dict) -> str:
         )
         lines.append(f"  history: {hist}")
         lines.append(f"  observations: {obs}")
+        if so.get("rank_health_rows") or so.get("quarantine_rows"):
+            lines.append(
+                f"  degraded ranks: rank_health_rows="
+                f"{so['rank_health_rows']} "
+                f"quarantine_rows={so['quarantine_rows']}"
+            )
         for name, c in so["calibration"].items():
             lines.append(
                 f"  calibrated {name}={c['value']:.4g} (n={c['n']})"

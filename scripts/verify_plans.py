@@ -150,6 +150,62 @@ def _verify_static(
     return errors
 
 
+# capacity-weighted golden corpus (ISSUE: straggler-aware elastic
+# dispatch): every canonical mask solved at cp=4 under a one-slow and a
+# one-drained capacity vector must pass R1-R4 including the weighted R2
+# balance sub-check, and an all-ones vector must reproduce the uniform
+# partitions bit-identically.
+WEIGHTED_CP = 4
+WEIGHTED_VECTORS: tuple[tuple[str, tuple[float, ...]], ...] = (
+    ("one_slow", (1.0, 1.0, 1.0, 0.25)),
+    ("one_drained", (1.0, 1.0, 1.0, 0.0)),
+)
+
+
+def _verify_weighted(
+    name: str, caps_name: str, caps: tuple[float, ...], verbose: bool
+) -> int:
+    from magiattention_tpu.analysis.violation import ERROR
+
+    qr_l, kr_l, tm = canonical_masks()[name]
+    qr = AttnRanges.from_ranges(qr_l)
+    kr = AttnRanges.from_ranges(kr_l)
+    cfg = DistAttnConfig()
+    mq, mkv, bucket = make_dispatch_meta_from_qk_ranges(
+        qr, kr, list(tm), SEQ, SEQ, CHUNK, WEIGHTED_CP,
+        cfg.dispatch_config, capacities=list(caps),
+    )
+    cmm, calc = make_attn_meta_from_dispatch_meta(
+        bucket, mq, cfg, dispatch_meta_kv=mkv
+    )
+    report = verify_plan(
+        dispatch_meta=mq,
+        bucket=bucket,
+        comm_meta=cmm,
+        calc_meta=calc,
+        global_slices=(qr, kr, list(tm), SEQ, SEQ),
+        split_alignment=cfg.grpcoll_config.split_alignment,
+        capacities=caps,
+    )
+    label = f"{name}/cp{WEIGHTED_CP}/w-{caps_name}"
+    # all-ones must be byte-identical to the uniform solve (warm caches
+    # stay warm when straggler detection finds nothing)
+    mq_base, _, _ = make_dispatch_meta_from_qk_ranges(
+        qr, kr, list(tm), SEQ, SEQ, CHUNK, WEIGHTED_CP, cfg.dispatch_config
+    )
+    mq_ones, _, _ = make_dispatch_meta_from_qk_ranges(
+        qr, kr, list(tm), SEQ, SEQ, CHUNK, WEIGHTED_CP,
+        cfg.dispatch_config, capacities=[1.0] * WEIGHTED_CP,
+    )
+    if mq_ones.partitions != mq_base.partitions:
+        report.add(
+            "R2", ERROR, label,
+            "all-ones capacity vector changed the uniform partitions "
+            f"({mq_ones.partitions} != {mq_base.partitions})",
+        )
+    return _report(label, report, verbose)
+
+
 # two-level (DCN x ICI) golden corpus: mesh shapes x masks; every plan must
 # carry solver-attached hier plans and pass the R3 fabric-split sub-check
 # (phase-A + phase-B rows reconstruct the flat sends, exactly-once DCN)
@@ -393,6 +449,10 @@ def main(argv: list[str] | None = None) -> int:
         "--skip-roundtrip", action="store_true",
         help="skip the plan-wire round-trip rider over solver plans",
     )
+    ap.add_argument(
+        "--skip-weighted", action="store_true",
+        help="skip the capacity-weighted (one-slow / one-drained) sweep",
+    )
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print warnings")
     args = ap.parse_args(argv)
@@ -416,6 +476,13 @@ def main(argv: list[str] | None = None) -> int:
             if not args.skip_dynamic and cp > 1:
                 total_errors += _verify_dynamic(
                     name, cp, args.verbose, roundtrip=rt
+                )
+                n_plans += 1
+    if not args.skip_weighted:
+        for name in masks:
+            for caps_name, caps in WEIGHTED_VECTORS:
+                total_errors += _verify_weighted(
+                    name, caps_name, caps, args.verbose
                 )
                 n_plans += 1
     if not args.skip_two_level:

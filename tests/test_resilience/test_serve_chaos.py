@@ -41,7 +41,7 @@ CONFIG_SHARDED = ServeConfig(
 
 needs_mesh = pytest.mark.skipif(
     len(jax.devices()) < 2,
-    reason="sharded rung needs >=2 devices (make chaos forces 2 host devices)",
+    reason="sharded rung needs >=2 devices (make chaos forces 4 host devices)",
 )
 
 

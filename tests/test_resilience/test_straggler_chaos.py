@@ -1,0 +1,361 @@
+"""Chaos matrix for straggler-aware elastic dispatch
+(docs/degraded_ranks.md): the ``rank_health_read``, ``weighted_solve`` and
+``step_retry`` sites each either RECOVER (MAGI_ATTENTION_FALLBACK=1 —
+degrading to the uniform all-ones plan or the next backend rung, recorded
+as a typed resilience event) or RAISE their typed InjectedFault. Plus the
+end-to-end acceptance path: a persistent 4x straggler is detected, triggers
+exactly one weighted re-solve, the weighted plan balances within 10% of the
+weighted ideal and stays parity-correct across the plan switch.
+"""
+
+import numpy as np
+import pytest
+
+from magiattention_tpu import telemetry
+from magiattention_tpu.common.enum import AttnMaskType, DispatchAlgType
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.config import DispatchConfig
+from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+from magiattention_tpu.resilience import watchdog
+from magiattention_tpu.resilience.errors import InjectedFault, NumericGuardError
+from magiattention_tpu.telemetry import health
+
+from tests.test_resilience.conftest import make_mgr, run_step
+
+# slow as well as chaos: every class runs real interpret-mode CP=4 steps
+# (~50s total), so this file rides `make chaos` rather than the fast tier
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+CP = 4
+
+STRAGGLER_ENV = (
+    "MAGI_ATTENTION_STRAGGLER_DETECT",
+    "MAGI_ATTENTION_STRAGGLER_EWMA",
+    "MAGI_ATTENTION_STRAGGLER_ENTER",
+    "MAGI_ATTENTION_STRAGGLER_EXIT",
+    "MAGI_ATTENTION_STRAGGLER_COOLDOWN",
+    "MAGI_ATTENTION_STRAGGLER_MIN_STEPS",
+    "MAGI_ATTENTION_STEP_RETRIES",
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_straggler_state(monkeypatch):
+    from magiattention_tpu.api.magi_attn_interface import clear_cache
+    from magiattention_tpu.dist_attn_runtime_mgr import _PLAN_CACHE
+
+    for var in STRAGGLER_ENV:
+        monkeypatch.delenv(var, raising=False)
+    health.reset()
+    watchdog.reset()
+    clear_cache()
+    _PLAN_CACHE.clear()
+    yield
+    health.reset()
+    watchdog.reset()
+    clear_cache()
+    _PLAN_CACHE.clear()
+
+
+def _degrade_rank3(slow_ms=40.0, healthy_ms=10.0, steps=8):
+    """Feed the monitor a persistent straggler on rank 3 (fake clock);
+    returns the transitions observed."""
+    transitions = []
+    for _ in range(steps):
+        for r in range(3):
+            health.observe_step(r, healthy_ms)
+        t = health.observe_step(3, slow_ms)
+        if t:
+            transitions.append(t)
+    return transitions
+
+
+# ---------------------------------------------------------------------------
+# site: rank_health_read — capacity-vector read at key planning
+# ---------------------------------------------------------------------------
+
+
+class TestRankHealthRead:
+    def test_recovers_to_uniform_plan(self, monkeypatch):
+        base_out, _ = run_step(make_mgr())
+        monkeypatch.setenv("MAGI_ATTENTION_STRAGGLER_DETECT", "1")
+        _degrade_rank3()
+        assert health.active_capacities(CP) is not None
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT", "rank_health_read:p=1.0"
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        mgr = make_mgr()
+        # the read degraded to the uniform all-ones vector: same plan,
+        # bit-identical step
+        assert mgr.key.capacities is None
+        out, _ = run_step(mgr)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base_out))
+
+    def test_raises_typed_without_fallback(self, monkeypatch):
+        monkeypatch.setenv("MAGI_ATTENTION_STRAGGLER_DETECT", "1")
+        _degrade_rank3()
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT", "rank_health_read"
+        )
+        with pytest.raises(InjectedFault, match="rank_health_read"):
+            make_mgr()
+
+
+# ---------------------------------------------------------------------------
+# site: weighted_solve — capacity-weighted dispatch solve
+# ---------------------------------------------------------------------------
+
+
+def _solve_meta(capacities=None):
+    return make_dispatch_meta_from_qk_ranges(
+        AttnRanges.from_ranges([[0, 256]]),
+        AttnRanges.from_ranges([[0, 256]]),
+        [AttnMaskType.CAUSAL], 256, 256, 16, CP,
+        dispatch_config=DispatchConfig(alg=DispatchAlgType.MIN_HEAP),
+        capacities=capacities,
+    )
+
+
+class TestWeightedSolve:
+    def test_recovers_to_uniform_partitions(self, monkeypatch):
+        mq_base, _, _ = _solve_meta()
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT", "weighted_solve:p=1.0"
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        mq, _, _ = _solve_meta(capacities=[1.0, 1.0, 1.0, 0.25])
+        assert mq.partitions == mq_base.partitions
+
+    def test_step_survives_weighted_solve_down(self, monkeypatch):
+        base_out, _ = run_step(make_mgr())
+        monkeypatch.setenv("MAGI_ATTENTION_STRAGGLER_DETECT", "1")
+        _degrade_rank3()
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT", "weighted_solve:p=1.0"
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        mgr = make_mgr()
+        # the key carries the vector but the solve degraded to uniform
+        assert mgr.key.capacities == (1.0, 1.0, 1.0, 0.25)
+        assert [len(p) for p in mgr.dispatch_meta_q.partitions] == [4] * CP
+        out, _ = run_step(mgr)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base_out))
+
+    def test_raises_typed_without_fallback(self, monkeypatch):
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT", "weighted_solve"
+        )
+        with pytest.raises(InjectedFault, match="weighted_solve"):
+            _solve_meta(capacities=[1.0, 1.0, 1.0, 0.25])
+
+
+# ---------------------------------------------------------------------------
+# site: step_retry — the watchdog's retry hop itself can fault
+# ---------------------------------------------------------------------------
+
+
+class TestStepRetry:
+    def test_retry_hop_fault_recovers(self, monkeypatch):
+        base_out, _ = run_step(make_mgr())
+        monkeypatch.setenv("MAGI_ATTENTION_STEP_RETRIES", "1")
+        monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT",
+            "nan_output:count=1,step_retry:p=1.0",
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        out, _ = run_step(make_mgr())
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(base_out), rtol=1e-5, atol=1e-5
+        )
+
+    def test_raises_typed_without_fallback(self, monkeypatch):
+        monkeypatch.setenv("MAGI_ATTENTION_STEP_RETRIES", "1")
+        monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT",
+            "nan_output:count=1,step_retry:p=1.0",
+        )
+        with pytest.raises(InjectedFault, match="step_retry"):
+            run_step(make_mgr())
+
+
+# ---------------------------------------------------------------------------
+# acceptance: numeric-guard trip -> next backend rung (or typed raise)
+# ---------------------------------------------------------------------------
+
+
+class TestNumericQuarantine:
+    def test_guard_trip_recovers_through_next_rung(
+        self, monkeypatch, tmp_path
+    ):
+        base_out, _ = run_step(make_mgr())
+        monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path / "tel")
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_STEP_RETRIES", "1")
+        monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT", "nan_output:count=1"
+        )
+        out, _ = run_step(make_mgr())
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(base_out), rtol=1e-5, atol=1e-5
+        )
+        c = telemetry.get_collector()
+        retry = c.last_event.get("step_retry")
+        assert retry is not None and retry["error"] == "NumericGuardError"
+        assert retry["to_backend"] is not None
+        assert c.counters.get("resilience.retry", 0) >= 1
+        assert c.counters.get("resilience.recovered", 0) >= 1
+
+    def test_guard_trip_raises_typed_with_retries_disabled(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT", "nan_output:count=1"
+        )
+        with pytest.raises(NumericGuardError):
+            run_step(make_mgr())
+
+    def test_repeated_trips_quarantine_backend(self):
+        key = {"mask": "m", "mesh": "cpu4"}
+        assert not watchdog.is_quarantined(key, "ffa")
+        assert not watchdog.note_trip(key, "ffa", allow_quarantine=True)
+        assert watchdog.note_trip(key, "ffa", allow_quarantine=True)
+        assert watchdog.is_quarantined(key, "ffa")
+        # the reference rung is never quarantined
+        assert not watchdog.note_trip(
+            key, "sdpa_online", allow_quarantine=False
+        )
+        assert not watchdog.note_trip(
+            key, "sdpa_online", allow_quarantine=False
+        )
+        assert not watchdog.is_quarantined(key, "sdpa_online")
+
+    def test_quarantined_start_rung_is_skipped(self, monkeypatch):
+        base_out, _ = run_step(make_mgr())
+        monkeypatch.setenv("MAGI_ATTENTION_STEP_RETRIES", "1")
+        mgr = make_mgr()
+        runtime = mgr.runtime
+        key = watchdog._decision_key(runtime)
+        watchdog.note_trip(key, runtime.backend, allow_quarantine=True)
+        watchdog.note_trip(key, runtime.backend, allow_quarantine=True)
+        assert watchdog.is_quarantined(key, runtime.backend)
+        out, _ = run_step(mgr)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(base_out), rtol=1e-5, atol=1e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# acceptance: persistent 4x straggler -> one weighted re-solve -> recovery
+# ---------------------------------------------------------------------------
+
+
+class TestStragglerAcceptance:
+    def test_detect_rebalance_parity_and_recovery(self, monkeypatch):
+        monkeypatch.setenv("MAGI_ATTENTION_STRAGGLER_DETECT", "1")
+        monkeypatch.setenv("MAGI_ATTENTION_STRAGGLER_MIN_STEPS", "4")
+        monkeypatch.setenv("MAGI_ATTENTION_STRAGGLER_COOLDOWN", "2")
+        base_mgr = make_mgr()
+        assert base_mgr.key.capacities is None
+        base_out, _ = run_step(base_mgr)
+
+        # phase 1: persistent 4x straggler on rank 3 — exactly one
+        # "degraded" transition, inside the hysteresis window
+        transitions = _degrade_rank3(slow_ms=40.0, healthy_ms=10.0, steps=8)
+        assert transitions == ["degraded"]
+        caps = health.active_capacities(CP)
+        assert caps == (1.0, 1.0, 1.0, 0.25)
+
+        # one weighted re-solve: the new key carries the vector
+        mgr_w = make_mgr()
+        assert mgr_w.key.capacities == caps
+        # ... and the vector is frozen, so further steps reuse the key
+        for _ in range(3):
+            for r in range(3):
+                health.observe_step(r, 10.0)
+            health.observe_step(3, 10.0)  # capacity share of the work
+        assert make_mgr().key == mgr_w.key
+
+        # post-rebalance balance: max weighted completion within 10% of
+        # the weighted ideal share
+        areas = {c.chunk_id: c.area for c in mgr_w.bucket.q_chunks}
+        per_rank = [
+            sum(areas[c] for c in p)
+            for p in mgr_w.dispatch_meta_q.partitions
+        ]
+        lb = max(
+            sum(areas.values()) / sum(caps),
+            max(areas.values()) / max(caps),
+        )
+        times = [per_rank[r] / caps[r] for r in range(CP) if caps[r] > 0]
+        assert max(times) <= 1.10 * lb
+        # the straggler's share shrank
+        assert per_rank[3] < min(per_rank[:3])
+
+        # parity across the plan switch
+        out_w, _ = run_step(mgr_w)
+        np.testing.assert_allclose(
+            np.asarray(out_w), np.asarray(base_out), rtol=1e-5, atol=1e-5
+        )
+
+        # phase 2: the rank heals (walls drop to its capacity share of
+        # the healthy wall) — exactly one "recovered" transition, and the
+        # uniform key is byte-identical to the original (warm cache)
+        recovered = []
+        for _ in range(24):
+            for r in range(3):
+                health.observe_step(r, 10.0)
+            t = health.observe_step(3, 2.5)
+            if t:
+                recovered.append(t)
+        assert recovered == ["recovered"]
+        assert health.active_capacities(CP) is None
+        mgr_back = make_mgr()
+        assert mgr_back.key == base_mgr.key
+        out_back, _ = run_step(mgr_back)
+        np.testing.assert_array_equal(
+            np.asarray(out_back), np.asarray(base_out)
+        )
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder: every new site down at once — still serves
+# ---------------------------------------------------------------------------
+
+
+class TestDegradationLadder:
+    def test_all_sites_down_still_serves_via_uniform_plan(
+        self, monkeypatch, tmp_path
+    ):
+        base_out, _ = run_step(make_mgr())
+        monkeypatch.setenv("MAGI_ATTENTION_TELEMETRY", "1")
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_TELEMETRY_DIR", str(tmp_path / "tel")
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_STRAGGLER_DETECT", "1")
+        _degrade_rank3()
+        monkeypatch.setenv("MAGI_ATTENTION_STEP_RETRIES", "1")
+        monkeypatch.setenv("MAGI_ATTENTION_NUMERIC_GUARD", "raise")
+        monkeypatch.setenv(
+            "MAGI_ATTENTION_FAULT_INJECT",
+            "rank_health_read:p=1.0,weighted_solve:p=1.0,"
+            "step_retry:p=1.0,nan_output:count=1",
+        )
+        monkeypatch.setenv("MAGI_ATTENTION_FALLBACK", "1")
+        mgr = make_mgr()
+        # the health read degraded first: uniform key, weighted solve
+        # never armed
+        assert mgr.key.capacities is None
+        out, _ = run_step(mgr)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(base_out), rtol=1e-5, atol=1e-5
+        )
+        counters = telemetry.get_collector().counters
+        assert counters.get("resilience.fallback", 0) >= 2
+        assert counters.get("resilience.recovered", 0) >= 1

@@ -1,0 +1,180 @@
+"""Capacity-weighted dispatch (docs/degraded_ranks.md).
+
+Three contracts pinned here:
+
+1. **Weighted targets** — with a non-uniform capacity vector the solver
+   assigns per-rank area proportional to capacity: the weighted makespan
+   ``max(area_r / w_r)`` lands within 10% of the weighted lower bound on
+   chunk sets fine-grained enough to balance.
+2. **Drained ranks** — a zero-capacity rank receives no chunks, and the
+   remaining ranks still cover every chunk exactly once.
+3. **Byte-identity for uniform weights** — ``capacities=None`` and any
+   all-equal vector (all-ones, all-twos) produce bit-identical solver
+   output AND bit-identical plan signatures, so warm PR 13 plan caches
+   stay warm when straggler detection is enabled but every rank is
+   healthy.
+"""
+
+import dataclasses
+
+import pytest
+
+from magiattention_tpu.common.enum import AttnMaskType, DispatchAlgType
+from magiattention_tpu.config import DispatchConfig
+from magiattention_tpu.common.ranges import AttnRanges
+from magiattention_tpu.meta import make_dispatch_meta_from_qk_ranges
+from magiattention_tpu.meta.solver.dispatch_solver import (
+    DispatchSolver,
+    normalize_capacities,
+)
+
+CP = 4
+
+
+def _areas(n=64, seed=3):
+    # deterministic, varied chunk areas — fine-grained enough that LPT can
+    # hit the weighted bound
+    return [((i * 2654435761 + seed) % 97) + 1 for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# normalize_capacities
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_none_and_uniform_collapse_to_none():
+    assert normalize_capacities(None, CP) is None
+    assert normalize_capacities([1.0] * CP, CP) is None
+    assert normalize_capacities([2.5] * CP, CP) is None
+
+
+def test_normalize_non_uniform_and_errors():
+    assert normalize_capacities([1, 1, 1, 0.5], CP) == (1.0, 1.0, 1.0, 0.5)
+    with pytest.raises(ValueError):
+        normalize_capacities([1.0, 1.0], CP)  # wrong length
+    with pytest.raises(ValueError):
+        normalize_capacities([1.0, -1.0, 1.0, 1.0], CP)  # negative
+    with pytest.raises(ValueError):
+        normalize_capacities([0.0] * CP, CP)  # all drained
+    with pytest.raises(ValueError):
+        normalize_capacities([1.0, float("nan"), 1.0, 1.0], CP)
+
+
+# ---------------------------------------------------------------------------
+# weighted targets
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "caps",
+    [(1.0, 1.0, 1.0, 0.25), (1.0, 0.5, 1.0, 0.5), (1.0, 0.125, 1.0, 1.0)],
+)
+def test_weighted_makespan_near_lower_bound(caps):
+    areas = _areas()
+    sol = DispatchSolver().solve(areas, CP, capacities=caps)
+    assert sol.capacities == caps
+    per_rank = [sum(areas[i] for i in p) for p in sol.partitions]
+    times = [per_rank[r] / caps[r] for r in range(CP) if caps[r] > 0]
+    assert sol.weighted_makespan == pytest.approx(max(times))
+    # acceptance bound: max weighted completion within 10% of the ideal
+    assert max(times) <= 1.10 * sol.weighted_lower_bound
+    assert sol.balance_ratio >= 1 / 1.10
+    # exactly-once cover
+    assert sorted(c for p in sol.partitions for c in p) == list(
+        range(len(areas))
+    )
+
+
+def test_weighted_area_proportional_to_capacity():
+    areas = [10] * 80
+    caps = (1.0, 1.0, 1.0, 0.25)
+    sol = DispatchSolver().solve(areas, CP, capacities=caps)
+    per_rank = [sum(areas[i] for i in p) for p in sol.partitions]
+    total, wsum = sum(areas), sum(caps)
+    for r in range(CP):
+        ideal = total * caps[r] / wsum
+        assert abs(per_rank[r] - ideal) <= 0.10 * ideal + max(areas)
+
+
+def test_drained_rank_gets_nothing():
+    areas = _areas(n=32)
+    sol = DispatchSolver().solve(areas, CP, capacities=(1, 1, 1, 0))
+    assert sol.partitions[3] == []
+    assert sorted(c for p in sol.partitions[:3] for c in p) == list(
+        range(len(areas))
+    )
+    # makespan is computed over active ranks only
+    per_rank = [sum(areas[i] for i in p) for p in sol.partitions[:3]]
+    assert sol.weighted_makespan == pytest.approx(max(per_rank))
+
+
+# ---------------------------------------------------------------------------
+# byte-identity for uniform weights
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("uniform", [None, [1.0] * CP, [3.0] * CP])
+def test_uniform_capacities_solver_output_identical(uniform):
+    areas = _areas(n=16)
+    base = DispatchSolver().solve(areas, CP)
+    got = DispatchSolver().solve(areas, CP, capacities=uniform)
+    assert got == base
+    assert got.capacities is None
+    assert got.weighted_makespan is None
+
+
+def test_uniform_capacities_meta_identical():
+    """The full dispatch-meta pipeline: all-ones capacities route through
+    the exact uniform code path (same partitions, same meta)."""
+    q = AttnRanges.from_ranges([[0, 256]])
+    k = AttnRanges.from_ranges([[0, 256]])
+    types = [AttnMaskType.CAUSAL]
+    kwargs = dict(
+        dispatch_config=DispatchConfig(alg=DispatchAlgType.MIN_HEAP),
+    )
+    mq_base, _, _ = make_dispatch_meta_from_qk_ranges(
+        q, k, types, 256, 256, 16, CP, **kwargs
+    )
+    mq_ones, _, _ = make_dispatch_meta_from_qk_ranges(
+        q, k, types, 256, 256, 16, CP, capacities=[1.0] * CP, **kwargs
+    )
+    assert mq_ones.partitions == mq_base.partitions
+    assert mq_ones.shard_seqlen == mq_base.shard_seqlen
+
+
+def test_weighted_meta_drains_zero_rank():
+    q = AttnRanges.from_ranges([[0, 256]])
+    k = AttnRanges.from_ranges([[0, 256]])
+    mq, _, _ = make_dispatch_meta_from_qk_ranges(
+        q, k, [AttnMaskType.CAUSAL], 256, 256, 16, CP,
+        dispatch_config=DispatchConfig(alg=DispatchAlgType.MIN_HEAP),
+        capacities=[1.0, 1.0, 1.0, 0.0],
+    )
+    assert mq.partitions[3] == []
+    assert sorted(c for p in mq.partitions for c in p) == list(range(16))
+
+
+def test_plan_signature_byte_identity_and_weighted_distinct():
+    """Uniform keys sign identically with and without the capacities
+    field (warm caches stay warm); a weighted key signs differently."""
+    import jax
+    import numpy as np
+
+    from magiattention_tpu.api import magi_attn_flex_key
+    from magiattention_tpu.dist_attn_runtime_mgr import _plan_signature
+
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:CP]), axis_names=("cp",)
+    )
+    key = magi_attn_flex_key(
+        [[0, 256]], [[0, 256]], ["causal"], 256, 256,
+        mesh=mesh, chunk_size=16,
+    )
+    assert key.capacities is None
+    sig_none = _plan_signature(key)
+    sig_ones = _plan_signature(
+        dataclasses.replace(key, capacities=None)
+    )
+    assert sig_none == sig_ones
+    weighted = dataclasses.replace(key, capacities=(1.0, 1.0, 1.0, 0.5))
+    assert _plan_signature(weighted) != sig_none

@@ -321,3 +321,89 @@ def test_compaction_preserves_registry_policy(active_store):
         "ffa_bwd", (1, 2, 3), lambda: pytest.fail("re-tuned after compact")
     )
     assert choice.name == "fused" and choice.source == "policy"
+
+
+# ---------------------------------------------------------------------------
+# degraded-rank rows: rank_health + quarantine + step_retry round trips
+# ---------------------------------------------------------------------------
+
+
+def test_rank_health_and_quarantine_round_trip(tmp_path):
+    """rank_health folds per-rank aggregates, quarantine rows persist and
+    clear, and a fresh handle reading the same directory agrees."""
+    d = str(tmp_path / "s")
+    st = TelemetryStore(d)
+    st.record_rank_health(3, wall_ms=40.0, ewma_ms=40.0, capacity=1.0,
+                          degraded=False)
+    st.record_rank_health(3, wall_ms=40.0, ewma_ms=40.0, capacity=0.25,
+                          degraded=True)
+    key = {"mask_sig": "m1", "mesh_sig": "cp4"}
+    st.record_quarantine("calc_attn", key, "ffa", 2)
+    st.record_quarantine("calc_attn", key, "sdpa", 2)
+    st.record_quarantine("calc_attn", key, "sdpa", 2, action="clear")
+    st.close()
+
+    other = TelemetryStore(d)
+    view = other.rank_health_view()
+    assert view["3"]["count"] == 2
+    assert view["3"]["capacity"] == 0.25
+    assert view["3"]["degraded"] is True
+    assert view["3"]["transitions"] == 1  # 1.0 -> 0.25
+    assert other.quarantined("calc_attn", key) == {"ffa"}
+
+    # compaction folds both into the snapshot
+    other.compact()
+    other.close()
+    third = TelemetryStore(d)
+    assert third.rank_health_view()["3"]["capacity"] == 0.25
+    assert third.quarantined("calc_attn", key) == {"ffa"}
+
+
+def test_ingest_rank_health_and_step_retry_reach_report(
+    active_store, tmp_path, capsys
+):
+    """Collector-emitted rank_health / step_retry records land in the
+    store AND in the JSONL stream, and telemetry_report renders both
+    sections (schema-documented)."""
+    telemetry.record_event(
+        "rank_health", rank=3, wall_ms=40.0, ewma_ms=40.0,
+        capacity=0.25, degraded=True, transition="degraded",
+    )
+    telemetry.record_event(
+        "rank_health", rank=0, wall_ms=10.0, ewma_ms=10.0,
+        capacity=1.0, degraded=False,
+    )
+    telemetry.record_event(
+        "step_retry", stage="DistAttnRuntime.calc_attn", attempt=0,
+        from_backend="ffa", to_backend="sdpa",
+        error="NumericGuardError", quarantined=False,
+    )
+    state = tstore.get_store().load()
+    assert state.rank_health["3"]["degraded"] is True
+    hkinds = {h.get("kind") for h in state.history.values()}
+    assert "step_retry" in hkinds
+    telemetry.reset()
+    tstore.reset()
+
+    mod = load_script(REPORT, "telemetry_report_rank_health_test")
+    records = mod.load_records([str(tmp_path)])
+    agg = mod.aggregate(records)
+    rh = agg["rank_health"]
+    assert rh["observations"] == 2
+    assert rh["degraded_now"] == 1
+    assert rh["transitions"] == {"degraded": 1}
+    assert rh["per_rank"]["3"]["capacity"] == 0.25
+    sr = agg["step_retry"]
+    assert sr["events"] == 1
+    assert sr["by_error"] == {"NumericGuardError": 1}
+    assert set(agg) <= set(mod.SECTION_SCHEMAS)
+
+    store_dir = str(tmp_path / "store")
+    agg["store"] = mod.aggregate_store(store_dir)
+    assert agg["store"]["rank_health_rows"] == 2
+    text = mod.format_summary(agg)
+    assert "rank health" in text and "step retries" in text
+    assert mod.main(["--json", "--store", store_dir, str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["rank_health"]["degraded_now"] == 1
+    assert out["step_retry"]["events"] == 1
